@@ -1,0 +1,48 @@
+"""foundationdb_tpu — a TPU-native re-design of FoundationDB's capabilities.
+
+A distributed, strictly-serializable, ordered key-value store whose MVCC
+conflict detection (the Resolver role; ref: fdbserver/Resolver.actor.cpp,
+fdbserver/SkipList.cpp) runs as a batched JAX kernel on TPU.
+
+Public API mirrors the shape of FoundationDB's Python binding
+(ref: bindings/python/fdb/__init__.py): ``open()`` returns a Database;
+transactions are run with ``db.run(fn)`` / the ``@transactional`` decorator.
+"""
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.keys import KeyRange, KeySelector, strinc, key_successor
+from foundationdb_tpu.core import options
+
+__version__ = "0.1.0"
+
+
+def open(cluster_file=None, **kw):
+    """Open an in-process cluster and return a Database handle.
+
+    Ref parity: fdb.open() in bindings/python/fdb/__init__.py. There is no
+    external fdbserver process here; the cluster (sequencer, proxies,
+    resolver, tlogs, storage) runs in-process with the resolver kernel on
+    the default JAX device.
+    """
+    from foundationdb_tpu.server.cluster import Cluster
+
+    cluster = Cluster(**kw)
+    return cluster.database()
+
+
+def transactional(func):
+    """Decorator: run ``func(tr, ...)`` in a retry loop.
+
+    Ref parity: @fdb.transactional in bindings/python/fdb/impl.py.
+    """
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(db_or_tr, *args, **kwargs):
+        from foundationdb_tpu.txn.transaction import Transaction
+
+        if isinstance(db_or_tr, Transaction):
+            return func(db_or_tr, *args, **kwargs)
+        return db_or_tr.run(lambda tr: func(tr, *args, **kwargs))
+
+    return wrapper

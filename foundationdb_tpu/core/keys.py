@@ -1,0 +1,195 @@
+"""Key model: byte keys, ranges, selectors, and the TPU limb encoding.
+
+FoundationDB keys are arbitrary byte strings (<= 10 kB), ordered
+lexicographically (ref: fdbclient/FDBTypes.h KeyRef; key limits in
+fdbclient/Knobs.h). The TPU resolver cannot chase pointers over variable
+length strings, so keys crossing into the conflict kernel are encoded as
+fixed-width vectors of uint32 *limbs* plus a length limb:
+
+    E(k) = (limb_0, ..., limb_{L-1}, len(k))        for len(k) <= 4*L
+
+Each limb packs 4 key bytes big-endian, zero-padded, so comparing encoded
+vectors lexicographically (limbs first, length last) matches byte-string
+order exactly for in-capacity keys: zero padding conflates b"ab" with
+b"ab\\x00" at the limb level, and the trailing length limb breaks that tie
+in the right direction.
+
+Keys longer than the capacity are *rounded conservatively*: lower bounds
+round down to their 4L-byte prefix and upper bounds round up to the
+prefix's 256-bit successor. Widening a read or write conflict range can
+only introduce false conflicts (a spurious retry), never a missed one —
+the same safety direction FDB itself leans on (e.g. conflict ranges are
+allowed to over-approximate; ref: ReadYourWrites.actor.cpp conflict-range
+accrual).
+"""
+
+import numpy as np
+
+MAX_KEY_SIZE = 10_000  # bytes; ref: CLIENT_KNOBS->KEY_SIZE_LIMIT
+MAX_VALUE_SIZE = 100_000  # ref: CLIENT_KNOBS->VALUE_SIZE_LIMIT
+DEFAULT_LIMBS = 8  # 32-byte exact prefix; tune per workload
+
+
+class KeyCodec:
+    """Encodes byte keys into fixed-width uint32 limb vectors.
+
+    ``width`` = num_limbs + 1 (trailing length limb). All encoded arrays
+    have dtype uint32 and compare lexicographically elementwise.
+    """
+
+    def __init__(self, num_limbs=DEFAULT_LIMBS):
+        assert num_limbs >= 1
+        self.num_limbs = int(num_limbs)
+        self.capacity = 4 * self.num_limbs
+        self.width = self.num_limbs + 1
+
+    def _pack(self, key):
+        limbs = np.zeros(self.width, dtype=np.uint32)
+        data = key[: self.capacity]
+        padded = data + b"\x00" * (self.capacity - len(data))
+        limbs[: self.num_limbs] = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+        return limbs
+
+    def encode_lower(self, key):
+        """Encode a lower (inclusive-begin) bound; rounds down if too long."""
+        limbs = self._pack(key)
+        limbs[-1] = min(len(key), self.capacity)
+        return limbs
+
+    def encode_upper(self, key):
+        """Encode an upper (exclusive-end) bound; rounds up if too long."""
+        limbs = self._pack(key)
+        if len(key) <= self.capacity:
+            limbs[-1] = len(key)
+            return limbs
+        # Successor of the 4L-byte prefix, as a 32L-bit increment.
+        for i in range(self.num_limbs - 1, -1, -1):
+            if limbs[i] != 0xFFFFFFFF:
+                limbs[i] += np.uint32(1)
+                limbs[i + 1 : self.num_limbs] = 0
+                limbs[-1] = 0
+                return limbs
+            limbs[i] = 0
+        # All-0xFF prefix: saturate above every encodable key.
+        limbs[: self.num_limbs] = np.uint32(0xFFFFFFFF)
+        limbs[-1] = np.uint32(self.capacity + 1)
+        return limbs
+
+    def encode_point(self, key):
+        """Encode point key k as the widened range [lower(k), upper(k+\\x00))."""
+        return self.encode_lower(key), self.encode_upper(key + b"\x00")
+
+    def encode_range(self, begin, end):
+        return self.encode_lower(begin), self.encode_upper(end)
+
+    def max_sentinel(self):
+        """An encoded value strictly greater than every encodable key."""
+        limbs = np.full(self.width, 0xFFFFFFFF, dtype=np.uint32)
+        return limbs
+
+
+def key_successor(key):
+    """Smallest key strictly greater than ``key``: key + b'\\x00'.
+
+    Ref: keyAfter() in fdbclient/FDBTypes.h.
+    """
+    return bytes(key) + b"\x00"
+
+
+def strinc(key):
+    """Smallest key not prefixed by ``key``.
+
+    Ref: strinc() in flow/flow.h — increments the last non-0xFF byte and
+    truncates; used for prefix ranges (subspace.range()).
+    """
+    key = bytes(key)
+    stripped = key.rstrip(b"\xff")
+    if not stripped:
+        raise ValueError("strinc of all-0xFF key has no successor")
+    return stripped[:-1] + bytes([stripped[-1] + 1])
+
+
+class KeyRange:
+    """Half-open byte-key range [begin, end). Ref: KeyRangeRef in FDBTypes.h."""
+
+    __slots__ = ("begin", "end")
+
+    def __init__(self, begin, end):
+        begin, end = bytes(begin), bytes(end)
+        if begin > end:
+            from foundationdb_tpu.core.errors import err
+
+            raise err("inverted_range")
+        self.begin = begin
+        self.end = end
+
+    @classmethod
+    def single_key(cls, key):
+        return cls(key, key_successor(key))
+
+    @classmethod
+    def prefix(cls, p):
+        return cls(p, strinc(p))
+
+    def __contains__(self, key):
+        return self.begin <= bytes(key) < self.end
+
+    def intersects(self, other):
+        return self.begin < other.end and other.begin < self.end
+
+    def empty(self):
+        return self.begin == self.end
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, KeyRange)
+            and self.begin == other.begin
+            and self.end == other.end
+        )
+
+    def __hash__(self):
+        return hash((self.begin, self.end))
+
+    def __repr__(self):
+        return f"KeyRange({self.begin!r}, {self.end!r})"
+
+
+class KeySelector:
+    """FDB key selector: resolved against the database's key order.
+
+    Ref: KeySelectorRef in fdbclient/FDBTypes.h and resolveKey in
+    storageserver.actor.cpp. Semantics: start from the last key <= (or <)
+    the reference key, then move ``offset`` keys forward.
+    """
+
+    __slots__ = ("key", "or_equal", "offset")
+
+    def __init__(self, key, or_equal, offset):
+        self.key = bytes(key)
+        self.or_equal = bool(or_equal)
+        self.offset = int(offset)
+
+    @classmethod
+    def last_less_than(cls, key):
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key):
+        return cls(key, True, 0)
+
+    @classmethod
+    def first_greater_than(cls, key):
+        return cls(key, True, 1)
+
+    @classmethod
+    def first_greater_or_equal(cls, key):
+        return cls(key, False, 1)
+
+    def __add__(self, n):
+        return KeySelector(self.key, self.or_equal, self.offset + n)
+
+    def __sub__(self, n):
+        return KeySelector(self.key, self.or_equal, self.offset - n)
+
+    def __repr__(self):
+        return f"KeySelector({self.key!r}, or_equal={self.or_equal}, offset={self.offset})"

@@ -1,0 +1,49 @@
+"""Knobs — tunable constants, mirroring flow/Knobs.h / fdbclient/Knobs.h.
+
+The headline knob is ``resolver_backend``: ``"tpu"`` routes conflict
+detection through the JAX kernel (ops/conflict.py); ``"cpu"`` uses the
+SkipList-style host ConflictSet (resolver/skiplist.py), matching the
+reference's default path.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Knobs:
+    # --- resolver ---
+    resolver_backend: str = "tpu"  # "tpu" | "cpu"
+    batch_txn_capacity: int = 1024  # T: txns per resolver batch (static shape)
+    point_reads_per_txn: int = 4  # PR
+    point_writes_per_txn: int = 4  # PW
+    range_reads_per_txn: int = 2  # RR
+    range_writes_per_txn: int = 2  # RW
+    hash_table_bits: int = 22  # point-write version table: 2^bits entries
+    range_ring_capacity: int = 4096  # recent range-write ring (exact lane)
+    coarse_buckets_bits: int = 14  # 2^bits contiguous key buckets (coarse lane)
+    key_limbs: int = 8  # 4*L bytes of exact key prefix on device
+
+    # --- versions / MVCC ---
+    versions_per_second: int = 1_000_000
+    max_read_transaction_life_versions: int = 5_000_000
+
+    # --- transaction limits (ref: fdbclient/Knobs.h CLIENT_KNOBS) ---
+    key_size_limit: int = 10_000
+    value_size_limit: int = 100_000
+    transaction_size_limit: int = 10_000_000
+
+    # --- retry loop (ref: CLIENT_KNOBS backoff) ---
+    max_retry_delay_s: float = 1.0
+    initial_backoff_s: float = 0.01
+    backoff_growth: float = 2.0
+
+    # --- proxy batching ---
+    commit_batch_interval_s: float = 0.0005
+    grv_batch_interval_s: float = 0.0005
+
+    # --- simulation ---
+    buggify: bool = False
+    buggify_prob: float = 0.05
+
+
+DEFAULT_KNOBS = Knobs()

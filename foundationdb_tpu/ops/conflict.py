@@ -1,0 +1,385 @@
+"""The TPU conflict-detection kernel — FDB's Resolver hot path, redesigned.
+
+Reference semantics (fdbserver/Resolver.actor.cpp + fdbserver/SkipList.cpp,
+ConflictSet::detectConflicts): a resolver keeps the last ~5s of committed
+write ranges; a transaction commits iff none of its read conflict ranges
+intersects a write range committed after the transaction's read version —
+including writes of earlier transactions *in the same batch* that were
+themselves accepted.
+
+The reference walks a lock-free skip list per conflict range. That design
+is pointer-chasing and branchy — exactly what a TPU cannot do. This kernel
+replaces it with four data-parallel structures, all fixed-shape device
+arrays updated in one fused jit step:
+
+1. **Point-version hash table** ``ht[2^HB]``: max commit-version offset per
+   key-hash bucket. Point writes scatter-max into it; point reads gather
+   and compare. Exact for point↔point conflicts up to hash collisions,
+   which only ever *add* conflicts (a spurious retry — safe, same
+   direction FDB's own conservative conflict ranges lean).
+
+2. **Range ring** of the most recent ``KR`` committed range-writes, kept
+   as limb-encoded intervals and checked exactly (vectorized interval
+   overlap, ops/intervals.py).
+
+3. **Coarse interval summary** ``(range_L, range_R)[C]`` over ``C``
+   order-contiguous key buckets, absorbing range-writes *evicted* from
+   the ring: scatter-max of the version at the interval's begin bucket
+   into L and end bucket into R. A query range [qlo,qhi] can only overlap
+   a stored interval if that interval starts at or before qhi (so its
+   version is ≤ prefix-max of L at qhi) *and* ends at or after qlo (≤
+   suffix-max of R at qlo); ``min(prefmax_L[qhi], sufmax_R[qlo])`` is
+   therefore an upper bound on the newest possibly-overlapping write —
+   conservative, never a miss.
+
+4. **Coarse point summary** ``point[C]``: per-bucket max version of all
+   point writes, with a per-batch sparse table for O(1) range-max — used
+   only by range reads (point reads use the exact hash table).
+
+Intra-batch ordering — the sequential part of the reference's resolver —
+becomes a **Jacobi fixpoint on the MXU**: build the strict-lower-
+triangular conflict matrix O[t',t] ("t' writes intersect t's reads"),
+then iterate  a ← a0 ∧ ¬(a·O)  until unchanged. The greedy sequential
+acceptance is the *unique* fixpoint of that map (induction on t: position
+0 is exact immediately, position t is exact once 0..t-1 are), and each
+iteration is one T×T matvec, so batches with conflict chains of depth d
+cost d matmuls instead of T dependent skip-list walks.
+
+Safety argument (why conservative lanes compose): every structure is used
+both to *record* accepted writes and to *check* reads, and each lane's
+check provably sees every write its record admitted (hash: same bucket;
+ring: exact; coarse: bucket monotonicity). Hence the accepted set is
+always mutually serializable — false positives only shrink it.
+
+Versions are uint32 offsets from a host-held base (core/versions.py);
+version 0 means "no write recorded".
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.ops.intervals import lex_lt, ranges_overlap
+
+
+class ResolverParams(NamedTuple):
+    """Static shape config (hashable; passed as a jit-static arg)."""
+
+    txns: int = 1024  # T
+    point_reads: int = 4  # PR per txn
+    point_writes: int = 4  # PW per txn
+    range_reads: int = 2  # RR per txn
+    range_writes: int = 2  # RW per txn
+    key_width: int = 9  # W = limbs + 1 (length limb)
+    hash_bits: int = 22  # point table size 2^HB
+    ring_capacity: int = 4096  # KR
+    bucket_bits: int = 14  # C = 2^bucket_bits coarse buckets
+
+
+class ResolverState(NamedTuple):
+    """Device-resident conflict history (the MVCC window)."""
+
+    window_start: jnp.ndarray  # uint32[] — oldest admissible read version
+    ht: jnp.ndarray  # uint32[2^HB] point-write version table
+    ring_b: jnp.ndarray  # uint32[KR, W] range-write begins
+    ring_e: jnp.ndarray  # uint32[KR, W] range-write ends
+    ring_v: jnp.ndarray  # uint32[KR] commit versions
+    ring_lo: jnp.ndarray  # int32[KR] begin bucket
+    ring_hi: jnp.ndarray  # int32[KR] end bucket
+    ring_mask: jnp.ndarray  # bool[KR]
+    ring_head: jnp.ndarray  # int32[]
+    range_L: jnp.ndarray  # uint32[C] evicted range-writes: v at begin bucket
+    range_R: jnp.ndarray  # uint32[C] evicted range-writes: v at end bucket
+    point_coarse: jnp.ndarray  # uint32[C] point writes per bucket
+
+
+class ResolveBatch(NamedTuple):
+    """One commit batch, packed to static shapes (invalid slots masked)."""
+
+    rv: jnp.ndarray  # uint32[T] read-version offsets
+    txn_mask: jnp.ndarray  # bool[T]
+    pr_hash: jnp.ndarray  # uint32[T, PR]
+    pr_key: jnp.ndarray  # uint32[T, PR, W] limb-encoded point-read keys
+    pr_bucket: jnp.ndarray  # int32[T, PR]
+    pr_mask: jnp.ndarray  # bool[T, PR]
+    pw_hash: jnp.ndarray  # uint32[T, PW]
+    pw_key: jnp.ndarray  # uint32[T, PW, W]
+    pw_bucket: jnp.ndarray  # int32[T, PW]
+    pw_mask: jnp.ndarray  # bool[T, PW]
+    rr_b: jnp.ndarray  # uint32[T, RR, W]
+    rr_e: jnp.ndarray  # uint32[T, RR, W]
+    rr_lo: jnp.ndarray  # int32[T, RR]
+    rr_hi: jnp.ndarray  # int32[T, RR]
+    rr_mask: jnp.ndarray  # bool[T, RR]
+    rw_b: jnp.ndarray  # uint32[T, RW, W]
+    rw_e: jnp.ndarray  # uint32[T, RW, W]
+    rw_lo: jnp.ndarray  # int32[T, RW]
+    rw_hi: jnp.ndarray  # int32[T, RW]
+    rw_mask: jnp.ndarray  # bool[T, RW]
+    cv: jnp.ndarray  # uint32[] commit-version offset for this batch
+    new_window_start: jnp.ndarray  # uint32[]
+
+
+from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD  # noqa: E402
+
+
+def init_state(params: ResolverParams) -> ResolverState:
+    kr, c, w = params.ring_capacity, 1 << params.bucket_bits, params.key_width
+    u32 = jnp.uint32
+    return ResolverState(
+        window_start=jnp.zeros((), u32),
+        ht=jnp.zeros((1 << params.hash_bits,), u32),
+        ring_b=jnp.zeros((kr, w), u32),
+        ring_e=jnp.zeros((kr, w), u32),
+        ring_v=jnp.zeros((kr,), u32),
+        ring_lo=jnp.zeros((kr,), jnp.int32),
+        ring_hi=jnp.zeros((kr,), jnp.int32),
+        ring_mask=jnp.zeros((kr,), bool),
+        ring_head=jnp.zeros((), jnp.int32),
+        range_L=jnp.zeros((c,), u32),
+        range_R=jnp.zeros((c,), u32),
+        point_coarse=jnp.zeros((c,), u32),
+    )
+
+
+def _sparse_table(vals):
+    """Sparse-table (doubling) range-max preprocessing over a 1-D array.
+
+    Returns list of arrays: level l gives max over [i, i + 2^l)."""
+    levels = [vals]
+    n = vals.shape[0]
+    span = 1
+    while span < n:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[span:], jnp.zeros((span,), prev.dtype)])
+        levels.append(jnp.maximum(prev, shifted))
+        span *= 2
+    return levels
+
+
+def _range_max(levels, lo, hi):
+    """Max over [lo, hi] inclusive (int32 indices, lo <= hi), O(1)/query."""
+    length = (hi - lo + 1).astype(jnp.float32)
+    j = jnp.floor(jnp.log2(jnp.maximum(length, 1.0))).astype(jnp.int32)
+    j = jnp.clip(j, 0, len(levels) - 1)
+    stacked = jnp.stack(levels)  # [L, C]
+    n = levels[0].shape[0]
+    a = stacked[j, jnp.clip(lo, 0, n - 1)]
+    b = stacked[j, jnp.clip(hi - (1 << j) + 1, 0, n - 1)]
+    return jnp.maximum(a, b)
+
+
+def _point_in(k, b, e):
+    """bool: limb key k in [b, e). Broadcasting over leading dims."""
+    return (~lex_lt(k, b)) & lex_lt(k, e)
+
+
+def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverParams):
+    """One resolver step: statuses for a batch + updated history. Pure/jittable.
+
+    Ref parity: Resolver::resolveBatch + ConflictSet::detectConflicts.
+    """
+    T = params.txns
+    u32 = jnp.uint32
+    rv = batch.rv  # [T]
+
+    # ───────────────────────── history conflicts ─────────────────────────
+    too_old = rv < state.window_start
+
+    hist = jnp.zeros((T,), bool)
+
+    # point reads vs point-write hash table (exact lane)
+    if params.point_reads:
+        ht_v = state.ht[batch.pr_hash & u32((1 << params.hash_bits) - 1)]  # [T, PR]
+        hit = (ht_v > rv[:, None]) & batch.pr_mask
+        # point reads vs recent range-writes (exact ring)
+        in_rng = _point_in(
+            batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
+        )  # [T, PR, KR]
+        newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+        hit |= jnp.any(in_rng & newer, axis=2) & batch.pr_mask
+        # point reads vs evicted range-writes (coarse interval summary)
+        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
+        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
+        coarse = jnp.minimum(pref_L[batch.pr_bucket], suf_R[batch.pr_bucket])
+        hit |= (coarse > rv[:, None]) & batch.pr_mask
+        hist |= jnp.any(hit, axis=1)
+    else:
+        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
+        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
+
+    # range reads vs ring (exact), coarse ranges, and coarse points
+    if params.range_reads:
+        ov = ranges_overlap(
+            batch.rr_b[:, :, None, :],
+            batch.rr_e[:, :, None, :],
+            state.ring_b[None, None],
+            state.ring_e[None, None],
+        )  # [T, RR, KR]
+        newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+        hit = jnp.any(ov & newer, axis=2) & batch.rr_mask
+        coarse_rng = jnp.minimum(pref_L[batch.rr_hi], suf_R[batch.rr_lo])
+        hit |= (coarse_rng > rv[:, None]) & batch.rr_mask
+        levels = _sparse_table(state.point_coarse)
+        pmax = _range_max(levels, batch.rr_lo, batch.rr_hi)
+        hit |= (pmax > rv[:, None]) & batch.rr_mask
+        hist |= jnp.any(hit, axis=1)
+
+    # ─────────────────────── intra-batch conflict matrix ───────────────────
+    # O[t1, t2]: an accepted t1 < t2 would abort t2 (t1's writes hit t2's reads)
+    O = jnp.zeros((T, T), bool)
+    if params.point_writes and params.point_reads:
+        wh = jnp.where(batch.pw_mask, batch.pw_hash, u32(0xFFFFFFFF))  # [T, PW]
+        rh = jnp.where(batch.pr_mask, batch.pr_hash, u32(0xFFFFFFFE))  # [T, PR]
+        eq = wh[:, :, None, None] == rh[None, None, :, :]  # [T1, PW, T2, PR]
+        O |= jnp.any(eq, axis=(1, 3))
+    if params.point_writes and params.range_reads:
+        inr = _point_in(
+            batch.pw_key[:, :, None, None, :], batch.rr_b[None, None], batch.rr_e[None, None]
+        )  # [T1, PW, T2, RR]
+        m = batch.pw_mask[:, :, None, None] & batch.rr_mask[None, None]
+        O |= jnp.any(inr & m, axis=(1, 3))
+    if params.range_writes and params.point_reads:
+        inr = _point_in(
+            batch.pr_key[None, None],  # [1, 1, T2, PR, W]
+            batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
+            batch.rw_e[:, :, None, None, :],
+        )  # [T1, RW, T2, PR]
+        m = batch.rw_mask[:, :, None, None] & batch.pr_mask[None, None]
+        O |= jnp.any(inr & m, axis=(1, 3))
+    if params.range_writes and params.range_reads:
+        ov = ranges_overlap(
+            batch.rr_b[None, None],  # [1, 1, T2, RR, W]
+            batch.rr_e[None, None],
+            batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
+            batch.rw_e[:, :, None, None, :],
+        )
+        m = batch.rw_mask[:, :, None, None] & batch.rr_mask[None, None]
+        O |= jnp.any(ov & m, axis=(1, 3))
+
+    strict_lower = jnp.tril(jnp.ones((T, T), bool), k=-1).T  # [t1 < t2]
+    O &= strict_lower & batch.txn_mask[:, None] & batch.txn_mask[None, :]
+
+    # ───────────────── Jacobi fixpoint for sequential acceptance ───────────
+    a0 = (~too_old) & (~hist) & batch.txn_mask
+    Of = O.astype(jnp.bfloat16)
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        a, _ = carry
+        killed = (
+            jnp.dot(a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32)
+            > 0.5
+        )
+        a_new = a0 & ~killed
+        return a_new, jnp.any(a_new != a)
+
+    accepted, _ = jax.lax.while_loop(cond, body, (a0, jnp.array(True)))
+
+    status = jnp.where(too_old, TOO_OLD, jnp.where(accepted, COMMITTED, CONFLICT))
+    status = jnp.where(batch.txn_mask, status, CONFLICT)
+
+    # ───────────────────────── history update ─────────────────────────────
+    cv = batch.cv
+    hb_mask = u32((1 << params.hash_bits) - 1)
+
+    ht = state.ht
+    point_coarse = state.point_coarse
+    if params.point_writes:
+        ok = batch.pw_mask & accepted[:, None]  # [T, PW]
+        flat_h = (batch.pw_hash & hb_mask).reshape(-1)
+        flat_bk = batch.pw_bucket.reshape(-1)
+        val = jnp.where(ok.reshape(-1), cv, u32(0))
+        ht = ht.at[flat_h].max(val, mode="promise_in_bounds")
+        point_coarse = point_coarse.at[jnp.clip(flat_bk, 0, point_coarse.shape[0] - 1)].max(val)
+
+    ring_b, ring_e, ring_v = state.ring_b, state.ring_e, state.ring_v
+    ring_lo, ring_hi, ring_mask = state.ring_lo, state.ring_hi, state.ring_mask
+    ring_head = state.ring_head
+    range_L, range_R = state.range_L, state.range_R
+    if params.range_writes:
+        kr = params.ring_capacity
+        ok = (batch.rw_mask & accepted[:, None]).reshape(-1)  # [T*RW]
+        slot_order = jnp.cumsum(ok) - 1  # position among accepted writes
+        pos = jnp.where(ok, (ring_head + slot_order) % kr, kr)  # kr = dropped
+        n_new = jnp.sum(ok)
+        # fold evicted entries into the coarse interval summary first
+        will_evict = jnp.zeros((kr,), bool).at[pos].set(True, mode="drop")
+        evict = will_evict & ring_mask
+        ev_val = jnp.where(evict, ring_v, u32(0))
+        range_L = range_L.at[jnp.clip(ring_lo, 0, range_L.shape[0] - 1)].max(ev_val)
+        range_R = range_R.at[jnp.clip(ring_hi, 0, range_R.shape[0] - 1)].max(ev_val)
+        # append
+        flat_b = batch.rw_b.reshape(-1, params.key_width)
+        flat_e = batch.rw_e.reshape(-1, params.key_width)
+        ring_b = ring_b.at[pos].set(flat_b, mode="drop")
+        ring_e = ring_e.at[pos].set(flat_e, mode="drop")
+        ring_v = ring_v.at[pos].set(jnp.where(ok, cv, u32(0)), mode="drop")
+        ring_lo = ring_lo.at[pos].set(batch.rw_lo.reshape(-1), mode="drop")
+        ring_hi = ring_hi.at[pos].set(batch.rw_hi.reshape(-1), mode="drop")
+        ring_mask = ring_mask.at[pos].set(ok, mode="drop")
+        ring_head = ((ring_head + n_new) % kr).astype(jnp.int32)
+
+    new_state = ResolverState(
+        window_start=batch.new_window_start,
+        ht=ht,
+        ring_b=ring_b,
+        ring_e=ring_e,
+        ring_v=ring_v,
+        ring_lo=ring_lo,
+        ring_hi=ring_hi,
+        ring_mask=ring_mask,
+        ring_head=ring_head,
+        range_L=range_L,
+        range_R=range_R,
+        point_coarse=point_coarse,
+    )
+    return status, accepted, new_state
+
+
+def validate_params(params: ResolverParams):
+    """Shape invariants the kernel's safety argument depends on."""
+    if params.txns * params.range_writes > params.ring_capacity:
+        raise ValueError(
+            f"ring_capacity {params.ring_capacity} < txns*range_writes "
+            f"{params.txns * params.range_writes}: one batch could wrap the "
+            "ring and silently drop committed range-writes from history"
+        )
+    if params.bucket_bits > 30 or params.hash_bits > 28:
+        raise ValueError("bucket_bits/hash_bits unreasonably large")
+
+
+def make_resolve_fn(params: ResolverParams, donate=True):
+    """jit-compiled resolver step with the history buffers donated."""
+    validate_params(params)
+    fn = lambda state, batch: resolve_batch(state, batch, params)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def rebase_state(state: ResolverState, delta):
+    """Shift all version offsets down by ``delta`` (saturating at 0).
+
+    Called by the host when offsets approach uint32 range
+    (core/versions.py REBASE_THRESHOLD). Safe when delta <= the current
+    window start: clamped-to-0 entries had versions no read inside the
+    window can still see (such reads are rejected TOO_OLD), so clamping
+    only forgets writes that can no longer conflict.
+    """
+    d = jnp.uint32(delta)
+
+    def shift(v):
+        return jnp.where(v > d, v - d, jnp.uint32(0))
+
+    return state._replace(
+        window_start=shift(state.window_start),
+        ht=shift(state.ht),
+        ring_v=shift(state.ring_v),
+        range_L=shift(state.range_L),
+        range_R=shift(state.range_R),
+        point_coarse=shift(state.point_coarse),
+    )

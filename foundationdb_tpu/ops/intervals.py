@@ -1,0 +1,113 @@
+"""Vectorized lexicographic compare and interval overlap on limb-encoded keys.
+
+This is the TPU replacement for SkipList::find's pointer-chasing key
+comparisons (ref: fdbserver/SkipList.cpp). Keys arrive as uint32 limb
+vectors (core/keys.py); comparisons are data-parallel over arbitrary
+leading batch dimensions, so a whole batch of conflict ranges is compared
+against a whole history of write ranges in one fused XLA computation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def lex_lt(a, b):
+    """Elementwise lexicographic a < b over the trailing limb axis.
+
+    a, b: uint32[..., W] (broadcastable). Returns bool[...].
+    """
+    eq = a == b
+    lt = a < b
+    # prefix_eq[..., i] == all limbs before i equal
+    prefix_eq = jnp.cumprod(eq, axis=-1, dtype=jnp.int32)
+    prefix_eq = jnp.concatenate(
+        [jnp.ones_like(prefix_eq[..., :1]), prefix_eq[..., :-1]], axis=-1
+    )
+    return jnp.any(lt & (prefix_eq > 0), axis=-1)
+
+
+def lex_le(a, b):
+    return ~lex_lt(b, a)
+
+
+def lex_eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def ranges_overlap(rb, re, wb, we):
+    """Half-open interval overlap: [rb, re) ∩ [wb, we) != ∅.
+
+    All operands uint32[..., W], broadcastable. Empty ranges (rb >= re)
+    never overlap anything by construction.
+    """
+    return lex_lt(rb, we) & lex_lt(wb, re)
+
+
+def conflicts_brute(rb, re, rv, wb, we, wv, wmask):
+    """Exact brute-force conflict check: each read range vs every write.
+
+    The direct dense formulation of ConflictSet::detectConflicts
+    (ref: fdbserver/SkipList.cpp): read range i conflicts iff some write
+    range j with commit version wv[j] > read version rv[i] overlaps it.
+    Used by the exact range lane and as the test oracle's device twin.
+
+    rb, re: uint32[Q, W]   read conflict ranges
+    rv:     uint32[Q]      read-version offsets
+    wb, we: uint32[K, W]   write ranges (history)
+    wv:     uint32[K]      commit-version offsets
+    wmask:  bool[K]        valid entries
+    Returns bool[Q].
+    """
+    ov = ranges_overlap(rb[:, None, :], re[:, None, :], wb[None, :, :], we[None, :, :])
+    newer = wv[None, :] > rv[:, None]
+    return jnp.any(ov & newer & wmask[None, :], axis=1)
+
+
+def point_in_ranges(pk, wb, we):
+    """bool[Q, K]: is point key pk[q] inside write range [wb[k], we[k))."""
+    ge = ~lex_lt(pk[:, None, :], wb[None, :, :])
+    lt = lex_lt(pk[:, None, :], we[None, :, :])
+    return ge & lt
+
+
+def fnv_hash(limbs):
+    """FNV-1a-style 32-bit hash folded over the trailing limb axis.
+
+    uint32[..., W] -> uint32[...]. Wraparound uint32 arithmetic maps
+    directly onto TPU int lanes.
+    """
+    h = jnp.full(limbs.shape[:-1], 2166136261, dtype=jnp.uint32)
+    for i in range(limbs.shape[-1]):
+        h = (h ^ limbs[..., i]) * jnp.uint32(16777619)
+    # final avalanche (xorshift-multiply)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    return h
+
+
+def searchsorted_limbs(sorted_keys, queries):
+    """Vectorized lower-bound binary search over limb-encoded sorted keys.
+
+    sorted_keys: uint32[M, W] ascending (lexicographic).
+    queries:     uint32[Q, W].
+    Returns int32[Q]: first index i with sorted_keys[i] >= query.
+    """
+    m = sorted_keys.shape[0]
+    q = queries.shape[0]
+    lo = jnp.zeros((q,), dtype=jnp.int32)
+    hi = jnp.full((q,), m, dtype=jnp.int32)
+    steps = max(1, m.bit_length())
+
+    def body(_, carry):
+        lo, hi = carry
+        active = lo < hi
+        mid = (lo + hi) // 2
+        mid_keys = sorted_keys[jnp.clip(mid, 0, m - 1)]
+        go_right = lex_lt(mid_keys, queries) & active  # sorted[mid] < query
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right | ~active, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo

@@ -1,0 +1,148 @@
+"""Host-side packing: TxnRequests → fixed-shape ResolveBatch arrays.
+
+The analog of ResolveTransactionBatchRequest serialization (ref:
+fdbserver/ResolverInterface.h): the commit proxy packs a batch of
+transactions' conflict ranges into device arrays once per batch; all key
+comparison work then happens on the TPU.
+
+Host hashing/bucketing MUST match the device (ops/intervals.fnv_hash):
+the hash table and coarse buckets are written by the kernel with values
+the host computed — keep the two implementations in lockstep (test:
+tests/test_resolver.py::test_host_device_hash_parity).
+"""
+
+import numpy as np
+
+from foundationdb_tpu.core.keys import KeyCodec
+from foundationdb_tpu.ops.conflict import ResolveBatch, ResolverParams
+
+
+def fnv_hash_np(limbs):
+    """numpy twin of ops.intervals.fnv_hash. limbs: uint32[..., W]."""
+    with np.errstate(over="ignore"):
+        h = np.full(limbs.shape[:-1], 2166136261, dtype=np.uint32)
+        for i in range(limbs.shape[-1]):
+            h = (h ^ limbs[..., i]) * np.uint32(16777619)
+        h = h ^ (h >> 16)
+        h = h * np.uint32(0x7FEB352D)
+        h = h ^ (h >> 15)
+    return h
+
+
+def bucket_of(limbs, bucket_bits):
+    """Coarse bucket = top bits of the first limb (monotone in the key)."""
+    return (limbs[..., 0] >> np.uint32(32 - bucket_bits)).astype(np.int32)
+
+
+class BatchPacker:
+    """Packs transactions for one resolver (arrival order preserved)."""
+
+    def __init__(self, params: ResolverParams):
+        self.params = params
+        self.codec = KeyCodec(num_limbs=params.key_width - 1)
+
+    def pack(self, txns, base_version, commit_version, new_window_start):
+        """txns: list[TxnRequest] (resolver/skiplist.py), len <= params.txns.
+
+        Versions are absolute; stored as uint32 offsets from base_version.
+        Oversize per-txn conflict-range lists spill into the range lanes
+        (a point op is just a tiny range), mirroring how the reference
+        treats all conflict ranges as ranges.
+        """
+        p = self.params
+        if len(txns) > p.txns:
+            raise ValueError(f"batch of {len(txns)} exceeds capacity {p.txns}")
+        T, W = p.txns, p.key_width
+        u32, i32 = np.uint32, np.int32
+
+        def off(v):
+            o = v - base_version
+            if o < 0:
+                o = 0
+            return u32(min(o, 0xFFFFFFFF))
+
+        rv = np.zeros(T, u32)
+        txn_mask = np.zeros(T, bool)
+        pr_key = np.zeros((T, p.point_reads, W), u32)
+        pr_mask = np.zeros((T, p.point_reads), bool)
+        pw_key = np.zeros((T, p.point_writes, W), u32)
+        pw_mask = np.zeros((T, p.point_writes), bool)
+        rr_b = np.zeros((T, p.range_reads, W), u32)
+        rr_e = np.zeros((T, p.range_reads, W), u32)
+        rr_mask = np.zeros((T, p.range_reads), bool)
+        rw_b = np.zeros((T, p.range_writes, W), u32)
+        rw_e = np.zeros((T, p.range_writes, W), u32)
+        rw_mask = np.zeros((T, p.range_writes), bool)
+
+        for t, txn in enumerate(txns):
+            txn_mask[t] = True
+            rv[t] = off(txn.read_version)
+            preads = list(txn.point_reads)
+            pwrites = list(txn.point_writes)
+            rreads = list(txn.range_reads)
+            rwrites = list(txn.range_writes)
+            # spill overflow point ops into the range lanes
+            if len(preads) > p.point_reads:
+                rreads += [(k, k + b"\x00") for k in preads[p.point_reads :]]
+                preads = preads[: p.point_reads]
+            if len(pwrites) > p.point_writes:
+                rwrites += [(k, k + b"\x00") for k in pwrites[p.point_writes :]]
+                pwrites = pwrites[: p.point_writes]
+            # coalesce range overflow into a single covering range (conservative)
+            if len(rreads) > p.range_reads:
+                if p.range_reads == 0:
+                    raise ValueError(
+                        "txn has range/overflow reads but params.range_reads=0"
+                    )
+                tail = rreads[p.range_reads - 1 :]
+                rreads = rreads[: p.range_reads - 1] + [
+                    (min(b for b, _ in tail), max(e for _, e in tail))
+                ]
+            if len(rwrites) > p.range_writes:
+                if p.range_writes == 0:
+                    raise ValueError(
+                        "txn has range/overflow writes but params.range_writes=0"
+                    )
+                tail = rwrites[p.range_writes - 1 :]
+                rwrites = rwrites[: p.range_writes - 1] + [
+                    (min(b for b, _ in tail), max(e for _, e in tail))
+                ]
+            for i, k in enumerate(preads):
+                pr_key[t, i] = self.codec.encode_lower(k)
+                pr_mask[t, i] = True
+            for i, k in enumerate(pwrites):
+                pw_key[t, i] = self.codec.encode_lower(k)
+                pw_mask[t, i] = True
+            for i, (b, e) in enumerate(rreads):
+                rr_b[t, i] = self.codec.encode_lower(b)
+                rr_e[t, i] = self.codec.encode_upper(e)
+                rr_mask[t, i] = True
+            for i, (b, e) in enumerate(rwrites):
+                rw_b[t, i] = self.codec.encode_lower(b)
+                rw_e[t, i] = self.codec.encode_upper(e)
+                rw_mask[t, i] = True
+
+        return ResolveBatch(
+            rv=rv,
+            txn_mask=txn_mask,
+            pr_hash=fnv_hash_np(pr_key),
+            pr_key=pr_key,
+            pr_bucket=bucket_of(pr_key, p.bucket_bits),
+            pr_mask=pr_mask,
+            pw_hash=fnv_hash_np(pw_key),
+            pw_key=pw_key,
+            pw_bucket=bucket_of(pw_key, p.bucket_bits),
+            pw_mask=pw_mask,
+            rr_b=rr_b,
+            rr_e=rr_e,
+            rr_lo=bucket_of(rr_b, p.bucket_bits),
+            rr_hi=bucket_of(rr_e, p.bucket_bits),
+            rr_mask=rr_mask,
+            rw_b=rw_b,
+            rw_e=rw_e,
+            rw_lo=bucket_of(rw_b, p.bucket_bits),
+            rw_hi=bucket_of(rw_e, p.bucket_bits),
+            rw_mask=rw_mask,
+            cv=np.uint32(commit_version - base_version),
+            new_window_start=np.uint32(max(0, new_window_start - base_version)),
+        )

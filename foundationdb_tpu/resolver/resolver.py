@@ -1,0 +1,99 @@
+"""The Resolver role — batched MVCC conflict detection behind a backend knob.
+
+Ref parity: fdbserver/Resolver.actor.cpp (resolveBatch). The commit proxy
+hands a batch of transactions in arrival order; the resolver returns
+per-txn statuses and remembers accepted writes for the MVCC window.
+
+``resolver_backend="tpu"`` packs the batch to device arrays and runs
+ops/conflict.py's jitted kernel (history buffers live on device and are
+donated across steps — no host↔device copies of state, only the batch in
+and T statuses out). ``"cpu"`` runs the exact host ConflictSet
+(resolver/skiplist.py; later a C++ twin via native/).
+"""
+
+import jax
+import numpy as np
+
+from foundationdb_tpu.core.options import DEFAULT_KNOBS
+from foundationdb_tpu.ops import conflict as ck
+from foundationdb_tpu.resolver.packing import BatchPacker
+from foundationdb_tpu.resolver.skiplist import CpuConflictSet
+
+COMMITTED, CONFLICT, TOO_OLD = ck.COMMITTED, ck.CONFLICT, ck.TOO_OLD
+
+
+class Resolver:
+    def __init__(self, knobs=DEFAULT_KNOBS, base_version=0):
+        self.knobs = knobs
+        self.backend = knobs.resolver_backend
+        self.base_version = base_version
+        if self.backend == "tpu":
+            self.params = ck.ResolverParams(
+                txns=knobs.batch_txn_capacity,
+                point_reads=knobs.point_reads_per_txn,
+                point_writes=knobs.point_writes_per_txn,
+                range_reads=knobs.range_reads_per_txn,
+                range_writes=knobs.range_writes_per_txn,
+                key_width=knobs.key_limbs + 1,
+                hash_bits=knobs.hash_table_bits,
+                ring_capacity=knobs.range_ring_capacity,
+                bucket_bits=knobs.coarse_buckets_bits,
+            )
+            self.packer = BatchPacker(self.params)
+            self.state = ck.init_state(self.params)
+            self._resolve = ck.make_resolve_fn(self.params)
+        elif self.backend == "cpu":
+            self.cset = CpuConflictSet()
+        else:
+            raise ValueError(f"unknown resolver_backend {self.backend!r}")
+
+    def resolve(self, txns, commit_version, new_window_start):
+        """txns: list[TxnRequest] in arrival order → list of statuses."""
+        if self.backend == "cpu":
+            return self.cset.resolve(txns, commit_version, new_window_start)
+        self._maybe_rebase(commit_version)
+        # base_version only ever advances to a past window start, so a read
+        # version below it is too old by construction — reject on host
+        # rather than letting the uint32 offset clamp to 0. Dropping these
+        # txns from the batch is safe: they commit nothing.
+        statuses = [None] * len(txns)
+        live = []
+        for i, t in enumerate(txns):
+            if t.read_version < self.base_version:
+                statuses[i] = TOO_OLD
+            else:
+                live.append((i, t))
+        for c in range(0, max(len(live), 1), self.params.txns):
+            chunk = live[c : c + self.params.txns]
+            batch = self.packer.pack(
+                [t for _, t in chunk], self.base_version, commit_version, new_window_start
+            )
+            status, _accepted, self.state = self._resolve(self.state, batch)
+            out = np.asarray(status)[: len(chunk)].tolist()
+            for (i, _), s in zip(chunk, out):
+                statuses[i] = s
+        return statuses
+
+    def _maybe_rebase(self, commit_version):
+        """Keep uint32 version offsets in range (core/versions.py).
+
+        Shifts the device state down by the current window start: entries
+        clamped to 0 are exactly those no admissible read can conflict
+        with anymore."""
+        from foundationdb_tpu.core.versions import REBASE_THRESHOLD
+
+        if commit_version - self.base_version < REBASE_THRESHOLD:
+            return
+        delta = int(jax.device_get(self.state.window_start))
+        if delta == 0:
+            raise RuntimeError(
+                "version offsets exceed rebase threshold but the MVCC window "
+                "never advanced; advance new_window_start to allow rebasing"
+            )
+        self.state = ck.rebase_state(self.state, delta)
+        self.base_version += delta
+
+    def window_start(self):
+        if self.backend == "cpu":
+            return self.cset.window_start
+        return self.base_version + int(jax.device_get(self.state.window_start))

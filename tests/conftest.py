@@ -1,0 +1,23 @@
+"""Test config: run on CPU with 8 virtual devices so sharding tests work
+without TPU hardware.
+
+Two layers of defense, because this image's sitecustomize registers an
+'axon' TPU PJRT plugin at interpreter start and force-sets jax_platforms
+to "axon,cpu" (claiming the single TPU terminal would serialize/hang
+concurrent test runs):
+  1. XLA_FLAGS for the 8-device virtual CPU mesh (honored at backend init,
+     which hasn't happened yet at conftest import time).
+  2. jax.config.update("jax_platforms", "cpu") — wins over the
+     sitecustomize override since it runs later, before any backend init.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

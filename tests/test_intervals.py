@@ -1,0 +1,81 @@
+"""Device interval ops vs a pure-Python oracle on byte strings."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from foundationdb_tpu.core.keys import KeyCodec, key_successor
+from foundationdb_tpu.ops import intervals as iv
+
+
+def rand_range(rng, codec):
+    alphabet = (0x00, 0x41, 0x42, 0xFF)
+    def rk():
+        return bytes(rng.choice(alphabet) for _ in range(rng.randrange(0, 6)))
+    a, b = rk(), rk()
+    if a > b:
+        a, b = b, a
+    if a == b:
+        b = key_successor(b)
+    return a, b
+
+
+def test_lex_lt_matches_bytes():
+    rng = random.Random(1)
+    codec = KeyCodec(num_limbs=2)
+    keys = [bytes(rng.choice((0, 0x61, 0xFF)) for _ in range(rng.randrange(0, 7))) for _ in range(64)]
+    enc = jnp.asarray(np.stack([codec.encode_lower(k) for k in keys]))
+    lt = np.asarray(iv.lex_lt(enc[:, None, :], enc[None, :, :]))
+    for i, a in enumerate(keys):
+        for j, b in enumerate(keys):
+            assert lt[i, j] == (a < b)
+
+
+def test_overlap_matches_oracle():
+    rng = random.Random(2)
+    codec = KeyCodec(num_limbs=2)
+    reads = [rand_range(rng, codec) for _ in range(50)]
+    writes = [rand_range(rng, codec) for _ in range(50)]
+    rb = jnp.asarray(np.stack([codec.encode_lower(a) for a, _ in reads]))
+    re_ = jnp.asarray(np.stack([codec.encode_upper(b) for _, b in reads]))
+    wb = jnp.asarray(np.stack([codec.encode_lower(a) for a, _ in writes]))
+    we = jnp.asarray(np.stack([codec.encode_upper(b) for _, b in writes]))
+    got = np.asarray(iv.ranges_overlap(rb[:, None, :], re_[:, None, :], wb[None, :, :], we[None, :, :]))
+    for i, (a1, b1) in enumerate(reads):
+        for j, (a2, b2) in enumerate(writes):
+            assert got[i, j] == (a1 < b2 and a2 < b1), (reads[i], writes[j])
+
+
+def test_conflicts_brute():
+    codec = KeyCodec(num_limbs=2)
+    rb = jnp.asarray(np.stack([codec.encode_lower(b"b"), codec.encode_lower(b"x")]))
+    re_ = jnp.asarray(np.stack([codec.encode_upper(b"d"), codec.encode_upper(b"z")]))
+    rv = jnp.asarray(np.array([10, 10], dtype=np.uint32))
+    wb = jnp.asarray(np.stack([codec.encode_lower(b"c"), codec.encode_lower(b"y")]))
+    we = jnp.asarray(np.stack([codec.encode_upper(b"c\x00"), codec.encode_upper(b"y\x00")]))
+    wv = jnp.asarray(np.array([11, 9], dtype=np.uint32))  # second write too old
+    wmask = jnp.asarray(np.array([True, True]))
+    got = np.asarray(iv.conflicts_brute(rb, re_, rv, wb, we, wv, wmask))
+    assert got.tolist() == [True, False]
+
+
+def test_searchsorted_limbs():
+    rng = random.Random(3)
+    codec = KeyCodec(num_limbs=2)
+    keys = sorted({bytes(rng.choice((0, 0x40, 0x80)) for _ in range(rng.randrange(1, 5))) for _ in range(40)})
+    arr = jnp.asarray(np.stack([codec.encode_lower(k) for k in keys]))
+    queries = [rng.choice(keys) for _ in range(10)] + [b"", b"\xff\xff\xff\xff\xff"]
+    q = jnp.asarray(np.stack([codec.encode_lower(k) for k in queries]))
+    got = np.asarray(iv.searchsorted_limbs(arr, q))
+    for qi, qk in enumerate(queries):
+        expect = sum(1 for k in keys if k < qk)
+        assert got[qi] == expect
+
+
+def test_fnv_hash_distinct():
+    codec = KeyCodec(num_limbs=2)
+    keys = [f"user{i}".encode() for i in range(1000)]
+    enc = jnp.asarray(np.stack([codec.encode_lower(k) for k in keys]))
+    h = np.asarray(iv.fnv_hash(enc))
+    assert len(set(h.tolist())) == len(keys)  # no collisions on this set

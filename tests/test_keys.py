@@ -1,0 +1,102 @@
+"""Key encoding: limb order must match byte-string order; long keys round
+conservatively (never narrower). Ref semantics: fdbclient/FDBTypes.h."""
+
+import random
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.keys import (
+    KeyCodec,
+    KeyRange,
+    KeySelector,
+    key_successor,
+    strinc,
+)
+
+
+def np_lex_lt(a, b):
+    for x, y in zip(a.tolist(), b.tolist()):
+        if x != y:
+            return x < y
+    return False
+
+
+def random_key(rng, max_len=12, alphabet=(0x00, 0x01, 0x61, 0x62, 0xFE, 0xFF)):
+    n = rng.randrange(0, max_len + 1)
+    return bytes(rng.choice(alphabet) for _ in range(n))
+
+
+def test_order_preserving_in_capacity():
+    rng = random.Random(0)
+    codec = KeyCodec(num_limbs=4)  # 16-byte capacity
+    keys = [random_key(rng) for _ in range(400)] + [b"", b"\x00", b"\xff" * 16]
+    enc = {k: codec.encode_lower(k) for k in keys}
+    for _ in range(3000):
+        a, b = rng.choice(keys), rng.choice(keys)
+        assert np_lex_lt(enc[a], enc[b]) == (a < b), (a, b)
+
+
+def test_length_tiebreak():
+    codec = KeyCodec(num_limbs=2)
+    a = codec.encode_lower(b"ab")
+    b = codec.encode_lower(b"ab\x00")
+    assert np_lex_lt(a, b)  # b"ab" < b"ab\x00"
+
+
+def test_point_encoding_covers_key():
+    codec = KeyCodec(num_limbs=2)
+    for k in [b"", b"x", b"abcdefgh", b"abcdefghijklmno"]:
+        lo, hi = codec.encode_point(k)
+        ek = codec.encode_lower(k)
+        assert not np_lex_lt(ek, lo) and np_lex_lt(ek, hi)
+
+
+def test_long_keys_round_conservatively():
+    codec = KeyCodec(num_limbs=2)  # 8-byte capacity
+    long_a = b"abcdefgh" + b"zzz"
+    long_b = b"abcdefgh" + b"zzzz"
+    lo = codec.encode_lower(long_a)
+    hi = codec.encode_upper(long_b)
+    # lower rounds down to (or below) the prefix; upper rounds above it.
+    prefix = codec.encode_lower(b"abcdefgh")
+    assert not np_lex_lt(prefix, lo)  # lo <= prefix encoding
+    assert np_lex_lt(codec.encode_lower(long_b), hi)  # hi > the actual key
+    assert np_lex_lt(lo, hi)  # widened range is non-empty
+
+
+def test_upper_increment_carries():
+    codec = KeyCodec(num_limbs=2)
+    key = b"\x00\x00\x00\x00\xff\xff\xff\xff" + b"tail"
+    up = codec.encode_upper(key)
+    expect = np.array([1, 0, 0], dtype=np.uint32)
+    assert up.tolist() == expect.tolist()
+
+
+def test_successor_and_strinc():
+    assert key_successor(b"a") == b"a\x00"
+    assert strinc(b"a") == b"b"
+    assert strinc(b"a\xff\xff") == b"b"
+    assert strinc(b"\x00") == b"\x01"
+    with pytest.raises(ValueError):
+        strinc(b"\xff\xff")
+
+
+def test_key_range():
+    r = KeyRange(b"a", b"c")
+    assert b"a" in r and b"b" in r and b"c" not in r
+    assert r.intersects(KeyRange(b"b", b"d"))
+    assert not r.intersects(KeyRange(b"c", b"d"))
+    assert KeyRange.single_key(b"k").end == b"k\x00"
+    assert KeyRange.prefix(b"p").end == b"q"
+    from foundationdb_tpu.core.errors import FDBError
+
+    with pytest.raises(FDBError):
+        KeyRange(b"b", b"a")
+
+
+def test_key_selectors():
+    ks = KeySelector.first_greater_or_equal(b"k")
+    assert ks.offset == 1 and not ks.or_equal
+    assert (ks + 2).offset == 3
+    assert KeySelector.last_less_than(b"k").offset == 0
