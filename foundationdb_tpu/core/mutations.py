@@ -1,0 +1,147 @@
+"""Mutation model + atomic operations.
+
+Ref parity: MutationRef in fdbclient/CommitTransaction.h and the atomic-op
+implementations in flow/Arena.h / fdbclient/AtomicOps (doLittleEndianAdd,
+doMin, doMax, doAnd, doOr, doXor, doByteMin, doByteMax, doAppendIfFits,
+doCompareAndClear). Atomics evaluate server-side at apply time; the RYW
+layer uses the same functions to show a transaction its own atomic writes.
+"""
+
+import enum
+import struct
+
+
+class Op(enum.Enum):
+    SET = "set"
+    CLEAR = "clear"  # single key
+    CLEAR_RANGE = "clear_range"
+    ADD = "add"
+    BIT_AND = "bit_and"
+    BIT_OR = "bit_or"
+    BIT_XOR = "bit_xor"
+    MIN = "min"
+    MAX = "max"
+    BYTE_MIN = "byte_min"
+    BYTE_MAX = "byte_max"
+    APPEND_IF_FITS = "append_if_fits"
+    COMPARE_AND_CLEAR = "compare_and_clear"
+    SET_VERSIONSTAMPED_KEY = "set_versionstamped_key"
+    SET_VERSIONSTAMPED_VALUE = "set_versionstamped_value"
+
+
+class Mutation:
+    """One mutation: (op, key[, param]) or (CLEAR_RANGE, begin, end)."""
+
+    __slots__ = ("op", "key", "param")
+
+    def __init__(self, op, key, param=None):
+        self.op = op
+        self.key = bytes(key)
+        self.param = param if param is None else bytes(param)
+
+    def __repr__(self):
+        return f"Mutation({self.op.value}, {self.key!r}, {self.param!r})"
+
+
+def _le_int(data, width):
+    """Little-endian unsigned int of ``width`` bytes (zero-padded)."""
+    padded = (data or b"")[:width].ljust(width, b"\x00")
+    return int.from_bytes(padded, "little")
+
+
+def apply_atomic(op, old, param):
+    """New value for key given existing ``old`` (None = absent) and param.
+
+    Widths follow FDB: the operand length defines the arithmetic width;
+    existing values are truncated/zero-padded to it (ref: doLittleEndianAdd
+    semantics). Returns None to mean "clear the key".
+    """
+    if op is Op.SET:
+        return param
+    if op is Op.CLEAR:
+        return None
+    width = len(param) if param is not None else 0
+    if op is Op.ADD:
+        if width == 0:
+            return b""
+        total = (_le_int(old, width) + _le_int(param, width)) % (1 << (8 * width))
+        return total.to_bytes(width, "little")
+    if op is Op.BIT_AND:
+        if old is None:
+            # ref: AND on absent key stores param (historical quirk kept
+            # by fdbclient's doAndV2)
+            return param
+        return (_le_int(old, width) & _le_int(param, width)).to_bytes(width, "little")
+    if op is Op.BIT_OR:
+        return (_le_int(old, width) | _le_int(param, width)).to_bytes(width, "little")
+    if op is Op.BIT_XOR:
+        return (_le_int(old, width) ^ _le_int(param, width)).to_bytes(width, "little")
+    if op is Op.MIN:
+        if old is None:
+            return param
+        return min(_le_int(old, width), _le_int(param, width)).to_bytes(width, "little")
+    if op is Op.MAX:
+        if old is None:
+            return param
+        return max(_le_int(old, width), _le_int(param, width)).to_bytes(width, "little")
+    if op is Op.BYTE_MIN:
+        if old is None:
+            return param
+        return min(old, param)
+    if op is Op.BYTE_MAX:
+        if old is None:
+            return param
+        return max(old, param)
+    if op is Op.APPEND_IF_FITS:
+        from foundationdb_tpu.core.keys import MAX_VALUE_SIZE
+
+        combined = (old or b"") + (param or b"")
+        return combined if len(combined) <= MAX_VALUE_SIZE else (old or b"")
+    if op is Op.COMPARE_AND_CLEAR:
+        return None if old == param else old
+    raise ValueError(f"not an atomic value op: {op}")
+
+
+VERSIONSTAMP_PLACEHOLDER = b"\xff" * 10
+
+
+def substitute_versionstamp(mutation, version, batch_order, txn_order):
+    """Resolve SET_VERSIONSTAMPED_KEY/VALUE into a plain SET at commit.
+
+    The final 4 bytes of key (VERSIONSTAMPED_KEY) or value
+    (VERSIONSTAMPED_VALUE) are a little-endian offset of the 10-byte
+    placeholder, per the v2 API-520+ format (ref: fdbclient/
+    CommitTransaction.h transformVersionstampMutation).
+    """
+    from foundationdb_tpu.core.versions import Versionstamp
+
+    stamp = Versionstamp.from_version(version, batch_order + txn_order).tr_version
+    if mutation.op is Op.SET_VERSIONSTAMPED_KEY:
+        data = mutation.key
+        (off,) = struct.unpack("<I", data[-4:])
+        if off + 10 > len(data) - 4:
+            raise ValueError("versionstamp offset out of range")
+        key = data[:off] + stamp + data[off + 10 : -4]
+        return Mutation(Op.SET, key, mutation.param)
+    if mutation.op is Op.SET_VERSIONSTAMPED_VALUE:
+        data = mutation.param
+        (off,) = struct.unpack("<I", data[-4:])
+        if off + 10 > len(data) - 4:
+            raise ValueError("versionstamp offset out of range")
+        val = data[:off] + stamp + data[off + 10 : -4]
+        return Mutation(Op.SET, mutation.key, val)
+    return mutation
+
+
+ATOMIC_OPS = {
+    Op.ADD,
+    Op.BIT_AND,
+    Op.BIT_OR,
+    Op.BIT_XOR,
+    Op.MIN,
+    Op.MAX,
+    Op.BYTE_MIN,
+    Op.BYTE_MAX,
+    Op.APPEND_IF_FITS,
+    Op.COMPARE_AND_CLEAR,
+}

@@ -176,14 +176,63 @@ def _point_in(k, b, e):
     return (~lex_lt(k, b)) & lex_lt(k, e)
 
 
-def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverParams):
+def resolve_batch(
+    state: ResolverState,
+    batch: ResolveBatch,
+    params: ResolverParams,
+    axis_name=None,
+    n_shards=1,
+):
     """One resolver step: statuses for a batch + updated history. Pure/jittable.
 
     Ref parity: Resolver::resolveBatch + ConflictSet::detectConflicts.
+
+    With ``axis_name`` set (under shard_map over a mesh axis), each device
+    is one resolver *shard* — the TPU analog of FDB's key-range-sharded
+    resolvers, but finer: the point hash table is hash-sharded, the range
+    ring is begin-bucket-sharded, the small coarse summaries are
+    replicated (pmax-synced), and the batch is replicated. Per-lane
+    invariant: whichever shard records a write is the shard whose check
+    can see it, so OR-reducing per-shard verdicts (psum) loses nothing.
+    Cross-device traffic per batch: a few [T]-bool reductions + two [C]
+    pmax — all ICI-friendly.
     """
     T = params.txns
     u32 = jnp.uint32
     rv = batch.rv  # [T]
+
+    if axis_name is None:
+        n_shards, shard_idx = 1, 0
+
+        def por(x):  # OR-reduce across shards
+            return x
+
+        def pmax_arr(x):
+            return x
+
+    else:
+        shard_idx = jax.lax.axis_index(axis_name)
+        mesh_n = jax.lax.axis_size(axis_name)
+        if n_shards != mesh_n:
+            raise ValueError(
+                f"n_shards={n_shards} does not match mesh axis "
+                f"{axis_name!r} size {mesh_n}: ownership masks would "
+                "silently un-own part of the key space"
+            )
+
+        def por(x):
+            return jax.lax.psum(x.astype(jnp.int32), axis_name) > 0
+
+        def pmax_arr(x):
+            return jax.lax.pmax(x, axis_name)
+
+    C = 1 << params.bucket_bits
+
+    def hash_owned(h):  # point-lane ownership: hash mod n
+        return (h % u32(n_shards)).astype(jnp.int32) == shard_idx
+
+    def bucket_owned(bucket):  # range-lane ownership: contiguous buckets
+        return (bucket * n_shards) // C == shard_idx
 
     # ───────────────────────── history conflicts ─────────────────────────
     too_old = rv < state.window_start
@@ -192,8 +241,9 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
 
     # point reads vs point-write hash table (exact lane)
     if params.point_reads:
+        own_pr = hash_owned(batch.pr_hash)
         ht_v = state.ht[batch.pr_hash & u32((1 << params.hash_bits) - 1)]  # [T, PR]
-        hit = (ht_v > rv[:, None]) & batch.pr_mask
+        hit = (ht_v > rv[:, None]) & batch.pr_mask & own_pr
         # point reads vs recent range-writes (exact ring)
         in_rng = _point_in(
             batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
@@ -227,11 +277,16 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
         hit |= (pmax > rv[:, None]) & batch.rr_mask
         hist |= jnp.any(hit, axis=1)
 
+    hist = por(hist)
+
     # ─────────────────────── intra-batch conflict matrix ───────────────────
-    # O[t1, t2]: an accepted t1 < t2 would abort t2 (t1's writes hit t2's reads)
+    # O[t1, t2]: an accepted t1 < t2 would abort t2 (t1's writes hit t2's
+    # reads). Each shard builds rows only from writes it owns; the Jacobi
+    # loop OR-reduces the kill vectors.
     O = jnp.zeros((T, T), bool)
     if params.point_writes and params.point_reads:
-        wh = jnp.where(batch.pw_mask, batch.pw_hash, u32(0xFFFFFFFF))  # [T, PW]
+        w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
+        wh = jnp.where(w_ok, batch.pw_hash, u32(0xFFFFFFFF))  # [T, PW]
         rh = jnp.where(batch.pr_mask, batch.pr_hash, u32(0xFFFFFFFE))  # [T, PR]
         eq = wh[:, :, None, None] == rh[None, None, :, :]  # [T1, PW, T2, PR]
         O |= jnp.any(eq, axis=(1, 3))
@@ -239,7 +294,8 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
         inr = _point_in(
             batch.pw_key[:, :, None, None, :], batch.rr_b[None, None], batch.rr_e[None, None]
         )  # [T1, PW, T2, RR]
-        m = batch.pw_mask[:, :, None, None] & batch.rr_mask[None, None]
+        w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
+        m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
         O |= jnp.any(inr & m, axis=(1, 3))
     if params.range_writes and params.point_reads:
         inr = _point_in(
@@ -247,7 +303,8 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
             batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
             batch.rw_e[:, :, None, None, :],
         )  # [T1, RW, T2, PR]
-        m = batch.rw_mask[:, :, None, None] & batch.pr_mask[None, None]
+        w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
+        m = w_ok[:, :, None, None] & batch.pr_mask[None, None]
         O |= jnp.any(inr & m, axis=(1, 3))
     if params.range_writes and params.range_reads:
         ov = ranges_overlap(
@@ -256,7 +313,8 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
             batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
             batch.rw_e[:, :, None, None, :],
         )
-        m = batch.rw_mask[:, :, None, None] & batch.rr_mask[None, None]
+        w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
+        m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
         O |= jnp.any(ov & m, axis=(1, 3))
 
     strict_lower = jnp.tril(jnp.ones((T, T), bool), k=-1).T  # [t1 < t2]
@@ -272,10 +330,12 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
 
     def body(carry):
         a, _ = carry
-        killed = (
-            jnp.dot(a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32)
-            > 0.5
+        killed_local = jnp.dot(
+            a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32
         )
+        if axis_name is not None:
+            killed_local = jax.lax.psum(killed_local, axis_name)
+        killed = killed_local > 0.5
         a_new = a0 & ~killed
         return a_new, jnp.any(a_new != a)
 
@@ -294,8 +354,13 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
         ok = batch.pw_mask & accepted[:, None]  # [T, PW]
         flat_h = (batch.pw_hash & hb_mask).reshape(-1)
         flat_bk = batch.pw_bucket.reshape(-1)
+        # hash table: only the owning shard records (its check lane reads it);
+        # point_coarse: replicated — every shard applies the identical update.
+        ht_ok = (ok & hash_owned(batch.pw_hash)).reshape(-1)
+        ht = ht.at[flat_h].max(
+            jnp.where(ht_ok, cv, u32(0)), mode="promise_in_bounds"
+        )
         val = jnp.where(ok.reshape(-1), cv, u32(0))
-        ht = ht.at[flat_h].max(val, mode="promise_in_bounds")
         point_coarse = point_coarse.at[jnp.clip(flat_bk, 0, point_coarse.shape[0] - 1)].max(val)
 
     ring_b, ring_e, ring_v = state.ring_b, state.ring_e, state.ring_v
@@ -304,7 +369,8 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
     range_L, range_R = state.range_L, state.range_R
     if params.range_writes:
         kr = params.ring_capacity
-        ok = (batch.rw_mask & accepted[:, None]).reshape(-1)  # [T*RW]
+        own_rw = bucket_owned(batch.rw_lo)
+        ok = (batch.rw_mask & own_rw & accepted[:, None]).reshape(-1)  # [T*RW]
         slot_order = jnp.cumsum(ok) - 1  # position among accepted writes
         pos = jnp.where(ok, (ring_head + slot_order) % kr, kr)  # kr = dropped
         n_new = jnp.sum(ok)
@@ -324,6 +390,9 @@ def resolve_batch(state: ResolverState, batch: ResolveBatch, params: ResolverPar
         ring_hi = ring_hi.at[pos].set(batch.rw_hi.reshape(-1), mode="drop")
         ring_mask = ring_mask.at[pos].set(ok, mode="drop")
         ring_head = ((ring_head + n_new) % kr).astype(jnp.int32)
+        # folds target arbitrary buckets; sync the replicated summaries
+        range_L = pmax_arr(range_L)
+        range_R = pmax_arr(range_R)
 
     new_state = ResolverState(
         window_start=batch.new_window_start,

@@ -1,0 +1,107 @@
+"""Multi-chip resolver: shard_map over a jax Mesh.
+
+FDB scales conflict detection by key-range-sharding resolvers across
+processes, with the commit proxy fanning out and AND-ing verdicts
+(ref: fdbserver/CommitProxyServer.actor.cpp resolution fan-out,
+fdbserver/Resolver.actor.cpp). The TPU analog keeps the whole resolver
+fleet inside ONE jit program over a device mesh: ops/conflict.py's
+``resolve_batch(axis_name='rs')`` runs as one SPMD program where every
+device owns a shard of the conflict history (hash-sharded point table,
+bucket-sharded range ring) and verdicts combine with psum/pmax over ICI —
+the XLA-collective replacement for the reference's FlowTransport RPC.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from foundationdb_tpu.ops import conflict as ck
+
+AXIS = "rs"
+
+
+def default_mesh(n_devices=None):
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _state_specs():
+    return ck.ResolverState(
+        window_start=P(),  # replicated scalar
+        ht=P(AXIS),
+        ring_b=P(AXIS),
+        ring_e=P(AXIS),
+        ring_v=P(AXIS),
+        ring_lo=P(AXIS),
+        ring_hi=P(AXIS),
+        ring_mask=P(AXIS),
+        ring_head=P(AXIS),  # [n] — one cursor per shard
+        range_L=P(),  # replicated coarse summaries (pmax-synced)
+        range_R=P(),
+        point_coarse=P(),
+    )
+
+
+def _batch_specs():
+    return jax.tree.map(lambda _: P(), ck.ResolveBatch(*ck.ResolveBatch._fields))
+
+
+class ShardedResolverKernel:
+    """The resolver fleet as one SPMD program.
+
+    Per-device history capacity equals ``params`` sizes, so global
+    capacity scales linearly with mesh size (hash table 2^HB * n, ring
+    KR * n), while the batch is replicated — exactly the axis FDB scales
+    resolvers on.
+    """
+
+    def __init__(self, params: ck.ResolverParams, mesh=None, donate=True):
+        ck.validate_params(params)
+        self.params = params
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.n = self.mesh.devices.size
+
+        fn = functools.partial(
+            ck.resolve_batch, params=params, axis_name=AXIS, n_shards=self.n
+        )
+        sharded = jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(_state_specs(), _batch_specs()),
+            out_specs=(P(), P(), _state_specs()),
+            check_vma=False,
+        )
+        self._step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        self.state = self.init_state()
+
+    def init_state(self):
+        p, n = self.params, self.n
+        kr, c, w = p.ring_capacity, 1 << p.bucket_bits, p.key_width
+        u32 = jnp.uint32
+
+        def put(arr, spec):
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+        return ck.ResolverState(
+            window_start=put(jnp.zeros((), u32), P()),
+            ht=put(jnp.zeros((n << p.hash_bits,), u32), P(AXIS)),
+            ring_b=put(jnp.zeros((n * kr, w), u32), P(AXIS)),
+            ring_e=put(jnp.zeros((n * kr, w), u32), P(AXIS)),
+            ring_v=put(jnp.zeros((n * kr,), u32), P(AXIS)),
+            ring_lo=put(jnp.zeros((n * kr,), jnp.int32), P(AXIS)),
+            ring_hi=put(jnp.zeros((n * kr,), jnp.int32), P(AXIS)),
+            ring_mask=put(jnp.zeros((n * kr,), bool), P(AXIS)),
+            ring_head=put(jnp.zeros((n,), jnp.int32), P(AXIS)),
+            range_L=put(jnp.zeros((c,), u32), P()),
+            range_R=put(jnp.zeros((c,), u32), P()),
+            point_coarse=put(jnp.zeros((c,), u32), P()),
+        )
+
+    def resolve(self, batch: ck.ResolveBatch):
+        status, accepted, self.state = self._step(self.state, batch)
+        return status, accepted

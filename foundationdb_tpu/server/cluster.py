@@ -1,0 +1,77 @@
+"""In-process cluster: wires sequencer, GRV/commit proxies, resolver(s),
+tlog, and storage into a database.
+
+Ref parity: the role wiring that ClusterController + Master recovery
+performs (fdbserver/ClusterController.actor.cpp,
+masterserver.actor.cpp). There is no separate process model here — the
+"simulation deployment" runs every role in-process, exactly how the
+reference's simulation (fdbrpc/sim2) hosts a whole cluster in one
+process for deterministic testing.
+"""
+
+import dataclasses
+
+from foundationdb_tpu.core.options import DEFAULT_KNOBS
+from foundationdb_tpu.resolver.resolver import Resolver
+from foundationdb_tpu.server.grv import GrvProxy
+from foundationdb_tpu.server.proxy import CommitProxy
+from foundationdb_tpu.server.ratekeeper import Ratekeeper
+from foundationdb_tpu.server.sequencer import Sequencer
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.server.tlog import TLog
+
+
+class Cluster:
+    def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
+                 version_clock="counter", **knob_overrides):
+        if knobs is None:
+            knobs = (
+                dataclasses.replace(DEFAULT_KNOBS, **knob_overrides)
+                if knob_overrides
+                else DEFAULT_KNOBS
+            )
+        self.knobs = knobs
+        self.sequencer = Sequencer(version_clock=version_clock)
+        self.ratekeeper = Ratekeeper()
+        self.resolvers = [Resolver(knobs) for _ in range(n_resolvers)]
+        self.tlog = TLog(wal_path=wal_path)
+        self.storages = [
+            StorageServer(window_versions=knobs.max_read_transaction_life_versions)
+            for _ in range(n_storage)
+        ]
+        self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
+        self.commit_proxy = CommitProxy(
+            self.sequencer, self.resolvers, self.tlog, self.storages,
+            knobs, self.ratekeeper,
+        )
+
+    # v1: single storage team holding the whole keyspace; reads go to [0].
+    @property
+    def storage(self):
+        return self.storages[0]
+
+    def database(self):
+        from foundationdb_tpu.txn.database import Database
+
+        return Database(self)
+
+    def status(self):
+        """Cluster status summary (ref: fdbcli status json, StatusWorker)."""
+        return {
+            "cluster": {
+                "generation": 1,
+                "database_available": True,
+                "workload": {
+                    "transactions": {
+                        "committed": {"counter": self.commit_proxy.commit_count},
+                        "conflicted": {"counter": self.commit_proxy.conflict_count},
+                        "started": {"counter": self.grv_proxy.grv_count},
+                    }
+                },
+                "latest_version": self.sequencer.committed_version,
+                "oldest_readable_version": self.storage.oldest_version,
+                "resolvers": len(self.resolvers),
+                "resolver_backend": self.knobs.resolver_backend,
+                "storage_servers": len(self.storages),
+            }
+        }

@@ -1,0 +1,22 @@
+"""GRV proxy: hands out read versions, gated by the ratekeeper.
+
+Ref parity: fdbserver/GrvProxyServer.actor.cpp — a read version is the
+latest committed version (so reads observe all prior commits: external
+consistency), batched across clients; the ratekeeper can delay or reject
+under saturation.
+"""
+
+from foundationdb_tpu.core.errors import err
+
+
+class GrvProxy:
+    def __init__(self, sequencer, ratekeeper=None):
+        self.sequencer = sequencer
+        self.ratekeeper = ratekeeper
+        self.grv_count = 0
+
+    def get_read_version(self, priority="default"):
+        if self.ratekeeper is not None and not self.ratekeeper.admit(priority):
+            raise err("process_behind")  # client backs off and retries
+        self.grv_count += 1
+        return self.sequencer.committed_version

@@ -1,0 +1,152 @@
+"""Commit proxy: batches client commits through resolution to the log.
+
+Ref parity: fdbserver/CommitProxyServer.actor.cpp commitBatch() — the
+pipeline is getVersion → resolve → tlog push → reply. Client commits
+accumulate into a batch; the whole batch shares one commit version. The
+TPU resolver makes large batches *cheaper* per txn, so the proxy's job is
+to keep batches full (the opposite pressure from the reference, whose
+resolver cost grows with batch size).
+"""
+
+from foundationdb_tpu.core.errors import FDBError, err
+from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
+from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
+from foundationdb_tpu.resolver.skiplist import TxnRequest
+
+
+class CommitRequest:
+    """What a client sends at commit (ref: CommitTransactionRequest)."""
+
+    __slots__ = ("read_version", "mutations", "read_conflict_ranges",
+                 "write_conflict_ranges", "report_conflicting_keys")
+
+    def __init__(self, read_version, mutations, read_conflict_ranges,
+                 write_conflict_ranges, report_conflicting_keys=False):
+        self.read_version = read_version
+        self.mutations = mutations
+        self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
+        self.write_conflict_ranges = write_conflict_ranges
+        self.report_conflicting_keys = report_conflicting_keys
+
+
+class CommitProxy:
+    def __init__(self, sequencer, resolvers, tlog, storages, knobs, ratekeeper=None):
+        self.sequencer = sequencer
+        self.resolvers = resolvers  # list; key-range sharded when >1
+        self.tlog = tlog
+        self.storages = storages
+        self.knobs = knobs
+        self.ratekeeper = ratekeeper
+        self.commit_count = 0
+        self.conflict_count = 0
+
+    def commit(self, request):
+        """Single-transaction batch (the synchronous client path)."""
+        return self.commit_batch([request])[0]
+
+    def commit_batch(self, requests):
+        """Resolve and commit a batch; returns per-request (version|FDBError).
+
+        All requests share one commit version, like the reference's
+        commitBatch. Mutations of accepted txns are pushed to the tlog in
+        batch order and applied to storage before replying, so a
+        subsequent GRV observes them (external consistency).
+        """
+        if not requests:
+            return []
+        cv = self.sequencer.next_commit_version()
+        window = max(0, cv - self.knobs.max_read_transaction_life_versions)
+
+        txns = [
+            TxnRequest(
+                read_version=r.read_version,
+                range_reads=list(r.read_conflict_ranges),
+                range_writes=list(r.write_conflict_ranges),
+            )
+            for r in requests
+        ]
+        statuses = self._resolve(txns, cv, window)
+
+        results = []
+        batch_mutations = []
+        batch_conflicts = 0
+        for i, (req, st) in enumerate(zip(requests, statuses)):
+            if st == COMMITTED:
+                muts = [
+                    substitute_versionstamp(m, cv, batch_order=0, txn_order=i)
+                    if m.op in (Op.SET_VERSIONSTAMPED_KEY, Op.SET_VERSIONSTAMPED_VALUE)
+                    else m
+                    for m in req.mutations
+                ]
+                batch_mutations.extend(muts)
+                results.append(cv)
+            elif st == TOO_OLD:
+                results.append(FDBError.from_name("transaction_too_old"))
+                batch_conflicts += 1
+            else:
+                results.append(FDBError.from_name("not_committed"))
+                batch_conflicts += 1
+        self.conflict_count += batch_conflicts
+        self.commit_count += sum(1 for r in results if not isinstance(r, FDBError))
+
+        # push even empty batches so storage's version advances with cv
+        self.tlog.push(cv, batch_mutations)
+        for s in self.storages:
+            s.apply(cv, batch_mutations)
+            s.advance_window(window)
+        self.sequencer.report_committed(cv)
+        if self.ratekeeper is not None:
+            self.ratekeeper.observe_commit(len(requests), batch_conflicts)
+        return results
+
+    def _resolve(self, txns, cv, window):
+        if len(self.resolvers) == 1:
+            return self.resolvers[0].resolve(txns, cv, window)
+        # Key-range sharded resolvers (ref: applyMetadataToCommittedTransactions
+        # fan-out): each resolver sees only conflict ranges overlapping its
+        # shard; a txn commits iff EVERY resolver accepts it. Because a txn's
+        # fate must be agreed, each resolver is also told the full batch
+        # structure (masked to its shard) and the proxy ANDs the verdicts.
+        n = len(self.resolvers)
+        verdicts = []
+        for ri, res in enumerate(self.resolvers):
+            lo, hi = self._shard_bounds(ri, n)
+            shard_txns = []
+            for t in txns:
+                shard_txns.append(
+                    TxnRequest(
+                        read_version=t.read_version,
+                        range_reads=_clip(t.range_reads, lo, hi),
+                        range_writes=_clip(t.range_writes, lo, hi),
+                    )
+                )
+            verdicts.append(res.resolve(shard_txns, cv, window))
+        out = []
+        for i in range(len(txns)):
+            vs = [v[i] for v in verdicts]
+            if any(v == TOO_OLD for v in vs):
+                out.append(TOO_OLD)
+            elif all(v == COMMITTED for v in vs):
+                out.append(COMMITTED)
+            else:
+                out.append(CONFLICT)
+        return out
+
+    def _shard_bounds(self, i, n):
+        """Evenly split the keyspace by first byte (v1 static shards;
+        DataDistribution will own real shard maps). The last shard's upper
+        bound is None = +infinity so no key — including the \\xff system
+        keyspace — escapes conflict checking."""
+        lo = bytes([256 * i // n]) if i else b""
+        hi = bytes([256 * (i + 1) // n]) if i + 1 < n else None
+        return lo, hi
+
+
+def _clip(ranges, lo, hi):
+    out = []
+    for b, e in ranges:
+        cb = max(b, lo)
+        ce = e if hi is None else min(e, hi)
+        if cb < ce:
+            out.append((cb, ce))
+    return out

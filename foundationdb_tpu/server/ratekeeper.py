@@ -1,0 +1,45 @@
+"""Ratekeeper: cluster-wide admission control.
+
+Ref parity: fdbserver/Ratekeeper.actor.cpp — computes a transactions-per-
+second budget from storage/tlog lag and conflict rates; GRV proxies
+enforce it by delaying read-version grants. Here the budget is a token
+bucket refilled from a smoothed target rate, adjusted down when commit
+latency or conflict ratio spikes.
+"""
+
+import time
+
+
+class Ratekeeper:
+    def __init__(self, target_tps=1e9, batch_priority_fraction=0.5):
+        self.target_tps = target_tps
+        self.batch_priority_fraction = batch_priority_fraction
+        self._tokens = target_tps
+        self._last_refill = time.monotonic()
+        self._recent_txns = 0
+        self._recent_conflicts = 0
+
+    def admit(self, priority="default"):
+        now = time.monotonic()
+        self._tokens = min(
+            self.target_tps, self._tokens + (now - self._last_refill) * self.target_tps
+        )
+        self._last_refill = now
+        need = 1.0
+        if priority == "batch":
+            # batch priority only runs when spare capacity exists
+            need = 1.0 / max(self.batch_priority_fraction, 1e-6)
+        elif priority == "immediate":
+            return True  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
+        if self._tokens >= need:
+            self._tokens -= need
+            return True
+        return False
+
+    def observe_commit(self, txns, conflicts):
+        """Both arguments are per-batch increments."""
+        self._recent_txns += txns
+        self._recent_conflicts += conflicts
+
+    def set_target_tps(self, tps):
+        self.target_tps = float(tps)

@@ -1,0 +1,172 @@
+"""Storage server: MVCC versioned reads over an ordered key space.
+
+Ref parity: fdbserver/storageserver.actor.cpp — serves reads at a client's
+read version within the 5s MVCC window, applies committed mutations in
+version order, resolves key selectors, supports watches. The reference
+layers a versioned in-memory tree over a persistent engine; here the
+versioned view is a SortedDict of per-key version chains over a pluggable
+KeyValueStore (server/kvstore.py) snapshot.
+"""
+
+from sortedcontainers import SortedDict
+
+from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
+
+
+class Watch:
+    """Fires when the watched key's value diverges from the seen value.
+
+    Ref: watchValue in storageserver.actor.cpp."""
+
+    def __init__(self, key, seen_value):
+        self.key = key
+        self.seen_value = seen_value
+        self.fired = False
+        self._callbacks = []
+
+    def on_fire(self, cb):
+        if self.fired:
+            cb()
+        else:
+            self._callbacks.append(cb)
+
+    def _fire(self):
+        if not self.fired:
+            self.fired = True
+            for cb in self._callbacks:
+                cb()
+
+
+class StorageServer:
+    def __init__(self, window_versions=5_000_000):
+        # key -> list[(version, value_or_None)] ascending; None = tombstone
+        self._data = SortedDict()
+        self.oldest_version = 0
+        self.version = 0  # latest applied
+        self.window_versions = window_versions
+        self._watches = {}  # key -> list[Watch]
+
+    # ───────────────────────────── writes ──────────────────────────────
+    def apply(self, version, mutations):
+        """Apply one commit's mutations at ``version`` (monotone order)."""
+        if version <= self.version:
+            raise ValueError(f"apply out of order: {version} <= {self.version}")
+        for m in mutations:
+            if m.op is Op.CLEAR_RANGE:
+                for k in list(self._data.irange(m.key, m.param, inclusive=(True, False))):
+                    self._append(k, version, None)
+            elif m.op in (Op.SET, Op.CLEAR):
+                self._append(m.key, version, m.param if m.op is Op.SET else None)
+            elif m.op in ATOMIC_OPS:
+                old = self._read_chain(m.key, version)
+                self._append(m.key, version, apply_atomic(m.op, old, m.param))
+            else:
+                raise ValueError(f"unresolved mutation {m.op} reached storage")
+        self.version = version
+        self.oldest_version = max(self.oldest_version, version - self.window_versions)
+
+    def _append(self, key, version, value):
+        chain = self._data.get(key)
+        if chain is None:
+            chain = []
+            self._data[key] = chain
+        chain.append((version, value))
+        # prune chain entries older than the window (keep the newest <= oldest)
+        if len(chain) > 4:
+            cut = 0
+            for i, (v, _) in enumerate(chain):
+                if v <= self.oldest_version:
+                    cut = i
+            if cut:
+                del chain[:cut]
+        for w in self._watches.get(key, []):
+            if value != w.seen_value:
+                w._fire()
+        if self._watches.get(key):
+            self._watches[key] = [w for w in self._watches[key] if not w.fired]
+
+    # ───────────────────────────── reads ───────────────────────────────
+    def _check_version(self, version):
+        if version < self.oldest_version:
+            raise err("transaction_too_old")
+        if version > self.version:
+            raise err("future_version")
+
+    def _read_chain(self, key, version):
+        chain = self._data.get(key)
+        if not chain:
+            return None
+        val = None
+        for v, x in chain:
+            if v <= version:
+                val = x
+            else:
+                break
+        return val
+
+    def get(self, key, version):
+        self._check_version(version)
+        return self._read_chain(key, version)
+
+    def _live_keys(self, begin, end, version, reverse=False):
+        it = self._data.irange(begin, end, inclusive=(True, False), reverse=reverse)
+        for k in it:
+            if self._read_chain(k, version) is not None:
+                yield k
+
+    def resolve_selector(self, sel: KeySelector, version):
+        """Resolve a key selector to a concrete key (ref: storageserver
+        findKey): start at the last live key < (or <=) sel.key, then move
+        ``offset`` live keys right. Clamps to b'' / \\xff sentinel."""
+        self._check_version(version)
+        base_idx = None  # index among live keys, conceptually
+        # walk from the reference key
+        if sel.or_equal:
+            prev = list(self._live_keys(b"", sel.key + b"\x00", version, reverse=True))
+        else:
+            prev = list(self._live_keys(b"", sel.key, version, reverse=True))
+        offset = sel.offset
+        if offset > 0:
+            start = prev[0] + b"\x00" if prev else b""
+            following = self._live_keys(start, b"\xff\xff", version)
+            k = None
+            for i, kk in enumerate(following, start=1):
+                if i == offset:
+                    k = kk
+                    break
+            return k if k is not None else b"\xff"
+        else:
+            # offset 0 => last-less-than(-or-equal); negative walks left
+            idx = -offset
+            if idx < len(prev):
+                return prev[idx]
+            return b""
+
+    def get_range(self, begin_sel, end_sel, version, limit=0, reverse=False):
+        """Half-open range read by key selectors. Returns list[(k, v)]."""
+        self._check_version(version)
+        begin = begin_sel if isinstance(begin_sel, bytes) else self.resolve_selector(begin_sel, version)
+        end = end_sel if isinstance(end_sel, bytes) else self.resolve_selector(end_sel, version)
+        if begin > end:
+            return []
+        out = []
+        for k in self._live_keys(begin, end, version, reverse=reverse):
+            out.append((k, self._read_chain(k, version)))
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    # ───────────────────────────── watches ─────────────────────────────
+    def watch(self, key, seen_value):
+        w = Watch(key, seen_value)
+        current = self._read_chain(key, self.version)
+        if current != seen_value:
+            w._fire()
+        else:
+            self._watches.setdefault(key, []).append(w)
+        return w
+
+    def advance_window(self, oldest):
+        self.oldest_version = max(self.oldest_version, oldest)

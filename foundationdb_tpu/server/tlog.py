@@ -1,0 +1,73 @@
+"""Transaction log: ordered durable record of committed mutations.
+
+Ref parity: fdbserver/TLogServer.actor.cpp — commit proxies push
+version-ordered mutation batches; storage servers peek from their durable
+version and pop when applied. Durability here is an optional append-only
+file WAL with length-framed records (the reference fsyncs a DiskQueue).
+"""
+
+import os
+import pickle
+import struct
+import zlib
+
+
+class TLog:
+    def __init__(self, wal_path=None, fsync=False):
+        self._log = []  # list[(version, mutations)]
+        self._first_version = 0
+        self.wal_path = wal_path
+        self.fsync = fsync
+        self._wal = open(wal_path, "ab") if wal_path else None
+
+    def push(self, version, mutations):
+        if self._log and version <= self._log[-1][0]:
+            raise ValueError("tlog push out of order")
+        self._log.append((version, mutations))
+        if self._wal is not None:
+            payload = pickle.dumps((version, mutations), protocol=4)
+            rec = struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
+            self._wal.write(rec)
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+
+    def peek(self, from_version):
+        """All records with version > from_version, in order."""
+        return [(v, m) for v, m in self._log if v > from_version]
+
+    def pop(self, up_to_version):
+        """Discard records <= up_to_version (applied durably downstream)."""
+        self._log = [(v, m) for v, m in self._log if v > up_to_version]
+        self._first_version = max(self._first_version, up_to_version)
+
+    @property
+    def last_version(self):
+        return self._log[-1][0] if self._log else self._first_version
+
+    def close(self):
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+    @staticmethod
+    def recover(wal_path):
+        """Replay a WAL file → list[(version, mutations)], tolerating a
+        torn tail (ref: DiskQueue recovery)."""
+        out = []
+        try:
+            with open(wal_path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return out
+        off = 0
+        while off + 8 <= len(data):
+            ln, crc = struct.unpack_from(">II", data, off)
+            if off + 8 + ln > len(data):
+                break  # torn tail
+            payload = data[off + 8 : off + 8 + ln]
+            if zlib.crc32(payload) != crc:
+                break
+            out.append(pickle.loads(payload))
+            off += 8 + ln
+        return out

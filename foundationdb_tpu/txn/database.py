@@ -1,0 +1,94 @@
+"""Database handle + retry loop.
+
+Ref parity: fdbclient Database/DatabaseContext plus the Python binding's
+``@fdb.transactional`` retry protocol (bindings/python/fdb/impl.py):
+run the function, commit, catch retryable errors via on_error, loop.
+"""
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.txn.transaction import Transaction
+
+
+class Database:
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._knobs = cluster.knobs
+
+    def create_transaction(self):
+        return Transaction(self)
+
+    def run(self, fn):
+        """Execute ``fn(tr)`` transactionally with automatic retries."""
+        tr = self.create_transaction()
+        while True:
+            try:
+                result = fn(tr)
+                tr.commit()
+                return result
+            except FDBError as e:
+                tr.on_error(e)  # re-raises when not retryable
+
+    transact = run
+
+    # one-shot conveniences (binding parity: db[key] etc.)
+    def get(self, key):
+        return self.run(lambda tr: tr.get(key))
+
+    def set(self, key, value):
+        self.run(lambda tr: tr.set(key, value))
+
+    def clear(self, key):
+        self.run(lambda tr: tr.clear(key))
+
+    def clear_range(self, begin, end):
+        self.run(lambda tr: tr.clear_range(begin, end))
+
+    def get_range(self, begin, end, **kw):
+        return self.run(lambda tr: tr.get_range(begin, end, **kw))
+
+    def get_range_startswith(self, prefix, **kw):
+        return self.run(lambda tr: tr.get_range_startswith(prefix, **kw))
+
+    def get_key(self, selector):
+        return self.run(lambda tr: tr.get_key(selector))
+
+    def watch(self, key):
+        out = {}
+
+        def _w(tr):
+            out["w"] = tr.watch(key)
+
+        self.run(_w)
+        return out["w"]
+
+    def add(self, key, param):
+        self.run(lambda tr: tr.add(key, param))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.get_range(key.start, key.stop)
+        return self.get(key)
+
+    def __setitem__(self, key, value):
+        self.set(key, value)
+
+    def __delitem__(self, key):
+        if isinstance(key, slice):
+            self.clear_range(key.start, key.stop)
+        else:
+            self.clear(key)
+
+    def status(self):
+        return self._cluster.status()
+
+    @property
+    def options(self):
+        return _DatabaseOptions()
+
+
+class _DatabaseOptions:
+    def set_transaction_timeout(self, ms):
+        pass
+
+    def set_transaction_retry_limit(self, n):
+        pass

@@ -1,0 +1,319 @@
+"""End-to-end: client API through the full commit pipeline
+(GRV → RYW reads → resolve on the TPU kernel → tlog → storage).
+Modeled on the reference's ApiCorrectness workload checks."""
+
+import pytest
+
+import foundationdb_tpu as fdb
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.server.cluster import Cluster
+
+TEST_KNOBS = dict(
+    batch_txn_capacity=16,
+    point_reads_per_txn=2,
+    point_writes_per_txn=2,
+    range_reads_per_txn=4,
+    range_writes_per_txn=4,
+    key_limbs=4,
+    hash_table_bits=14,
+    range_ring_capacity=64,
+    coarse_buckets_bits=8,
+    initial_backoff_s=0.0001,
+)
+
+
+@pytest.fixture()
+def db():
+    return Cluster(**TEST_KNOBS).database()
+
+
+def test_get_set_clear(db):
+    db[b"foo"] = b"bar"
+    assert db[b"foo"] == b"bar"
+    assert db[b"missing"] is None
+    del db[b"foo"]
+    assert db[b"foo"] is None
+
+
+def test_read_your_writes(db):
+    def fn(tr):
+        tr[b"a"] = b"1"
+        assert tr[b"a"] == b"1"  # own write visible
+        tr.clear(b"a")
+        assert tr[b"a"] is None
+        tr[b"a"] = b"2"
+        return tr[b"a"]
+
+    assert db.run(fn) == b"2"
+    assert db[b"a"] == b"2"
+
+
+def test_conflict_and_retry(db):
+    db[b"k"] = b"0"
+    t1 = db.create_transaction()
+    _ = t1[b"k"]  # t1 reads k
+    t2 = db.create_transaction()
+    t2[b"k"] = b"t2"
+    t2.commit()  # commits first
+    t1[b"other"] = b"x"
+    with pytest.raises(FDBError) as ei:
+        t1.commit()
+    assert ei.value.code == 1020  # not_committed
+    t1.on_error(ei.value)  # resets with backoff
+    _ = t1[b"k"]
+    t1[b"other"] = b"x"
+    t1.commit()  # fresh read version -> succeeds
+    assert db[b"other"] == b"x"
+
+
+def test_blind_writes_dont_conflict(db):
+    t1 = db.create_transaction()
+    t2 = db.create_transaction()
+    t1[b"k"] = b"1"
+    t2[b"k"] = b"2"
+    t1.commit()
+    t2.commit()  # last writer wins, no read -> no conflict
+    assert db[b"k"] == b"2"
+
+
+def test_snapshot_read_no_conflict(db):
+    db[b"k"] = b"0"
+    t1 = db.create_transaction()
+    _ = t1.snapshot[b"k"]
+    t2 = db.create_transaction()
+    t2[b"k"] = b"new"
+    t2.commit()
+    t1[b"out"] = b"1"
+    t1.commit()  # snapshot read added no conflict range
+    assert db[b"out"] == b"1"
+
+
+def test_atomic_ops(db):
+    db.add(b"ctr", (5).to_bytes(8, "little"))
+    db.add(b"ctr", (7).to_bytes(8, "little"))
+    assert int.from_bytes(db[b"ctr"], "little") == 12
+
+    def fn(tr):
+        tr.add(b"ctr", (1).to_bytes(8, "little"))
+        return tr[b"ctr"]  # RYW over atomic needs base read
+
+    assert int.from_bytes(db.run(fn), "little") == 13
+
+    db.run(lambda tr: tr.byte_max(b"bm", b"abc"))
+    db.run(lambda tr: tr.byte_max(b"bm", b"abd"))
+    assert db[b"bm"] == b"abd"
+    db.run(lambda tr: tr.compare_and_clear(b"bm", b"abd"))
+    assert db[b"bm"] is None
+
+
+def test_get_range_merges_writes(db):
+    for i in range(5):
+        db[b"r%02d" % i] = b"v%d" % i
+
+    def fn(tr):
+        tr[b"r01x"] = b"new"  # uncommitted insert
+        tr.clear(b"r03")  # uncommitted delete
+        return tr.get_range(b"r00", b"r99")
+
+    rows = db.run(fn)
+    keys = [k for k, _ in rows]
+    assert keys == [b"r00", b"r01", b"r01x", b"r02", b"r04"]
+    # limit + reverse
+    rows = db.get_range(b"r00", b"r99", limit=2, reverse=True)
+    assert [k for k, _ in rows] == [b"r04", b"r02"]
+
+
+def test_clear_range_and_startswith(db):
+    for i in range(5):
+        db[b"p/%d" % i] = b"x"
+    db[b"q"] = b"keep"
+    db.clear_range(b"p/0", b"p/3")
+    assert [k for k, _ in db.get_range_startswith(b"p/")] == [b"p/3", b"p/4"]
+    db.run(lambda tr: tr.clear_range_startswith(b"p/"))
+    assert db.get_range_startswith(b"p/") == []
+    assert db[b"q"] == b"keep"
+
+
+def test_key_selectors(db):
+    for k in [b"a", b"c", b"e"]:
+        db[k] = b"1"
+    assert db.get_key(KeySelector.first_greater_or_equal(b"b")) == b"c"
+    assert db.get_key(KeySelector.first_greater_than(b"c")) == b"e"
+    assert db.get_key(KeySelector.last_less_than(b"c")) == b"a"
+    assert db.get_key(KeySelector.last_less_or_equal(b"c")) == b"c"
+    assert db.get_key(KeySelector.first_greater_or_equal(b"z")) == b"\xff"
+
+
+def test_watch_fires_on_change(db):
+    db[b"w"] = b"0"
+    handle = db.watch(b"w")
+    assert handle.active and not handle.is_set()
+    db[b"w"] = b"1"
+    assert handle.is_set()
+    assert handle.wait(timeout=0.1)
+
+
+def test_watch_no_fire_on_same_value(db):
+    db[b"w"] = b"0"
+    handle = db.watch(b"w")
+    db[b"w"] = b"0"  # same value -> no fire
+    assert not handle.is_set()
+
+
+def test_versionstamp(db):
+    tr = db.create_transaction()
+    tr[b"k"] = b"v"
+    vsf = tr.get_versionstamp()
+    tr.commit()
+    stamp = vsf()
+    assert len(stamp) == 10
+    assert int.from_bytes(stamp[:8], "big") == tr.get_committed_version()
+
+
+def test_versionstamped_key(db):
+    import struct
+
+    def fn(tr):
+        key = b"log/" + b"\xff" * 10 + struct.pack("<I", 4)
+        tr.set_versionstamped_key(key, b"entry")
+
+    db.run(fn)
+    rows = db.get_range_startswith(b"log/")
+    assert len(rows) == 1 and rows[0][1] == b"entry"
+
+
+def test_transactional_decorator(db):
+    @fdb.transactional
+    def bump(tr, key):
+        cur = tr[key]
+        n = int(cur or b"0") + 1
+        tr[key] = b"%d" % n
+        return n
+
+    assert bump(db, b"n") == 1
+    assert bump(db, b"n") == 2
+    # also callable with an open transaction
+    tr = db.create_transaction()
+    assert bump(tr, b"n") == 3
+
+
+def test_read_only_commit_and_status(db):
+    db[b"x"] = b"1"
+    tr = db.create_transaction()
+    _ = tr[b"x"]
+    tr.commit()  # read-only: trivially succeeds
+    st = db.status()
+    assert st["cluster"]["database_available"]
+    assert st["cluster"]["workload"]["transactions"]["committed"]["counter"] >= 1
+
+
+def test_size_limits(db):
+    with pytest.raises(FDBError) as ei:
+        db.set(b"k" * 20_000, b"v")
+    assert ei.value.code == 2102
+    with pytest.raises(FDBError) as ei:
+        db.set(b"k", b"v" * 200_000)
+    assert ei.value.code == 2103
+
+
+def test_used_during_commit(db):
+    tr = db.create_transaction()
+    tr[b"k"] = b"v"
+    tr.commit()
+    with pytest.raises(FDBError) as ei:
+        tr[b"k2"] = b"v"
+    assert ei.value.code == 2017
+    tr.reset()
+    tr[b"k2"] = b"v2"
+    tr.commit()
+    assert db[b"k2"] == b"v2"
+
+
+def test_wal_recovery(tmp_path):
+    from foundationdb_tpu.server.tlog import TLog
+
+    wal = str(tmp_path / "wal.log")
+    db = Cluster(wal_path=wal, **TEST_KNOBS).database()
+    db[b"a"] = b"1"
+    db[b"b"] = b"2"
+    db._cluster.tlog.close()
+    records = TLog.recover(wal)
+    assert len(records) == 2
+    replayed = {m.key: m.param for _, muts in records for m in muts}
+    assert replayed == {b"a": b"1", b"b": b"2"}
+
+
+def test_cpu_backend_cluster():
+    db = Cluster(resolver_backend="cpu", **TEST_KNOBS).database()
+    db[b"k"] = b"v"
+    t1 = db.create_transaction()
+    _ = t1[b"k"]
+    t2 = db.create_transaction()
+    t2[b"k"] = b"2"
+    t2.commit()
+    t1[b"o"] = b"1"
+    with pytest.raises(FDBError):
+        t1.commit()
+
+
+def test_multi_resolver_sharded():
+    db = Cluster(n_resolvers=3, **TEST_KNOBS).database()
+    db[b"\x01aa"] = b"1"  # shard 0
+    db[b"\x85zz"] = b"2"  # shard 1+
+    t1 = db.create_transaction()
+    _ = t1[b"\x01aa"]
+    _ = t1[b"\x85zz"]
+    t2 = db.create_transaction()
+    t2[b"\x85zz"] = b"new"
+    t2.commit()
+    t1[b"out"] = b"x"
+    with pytest.raises(FDBError):
+        t1.commit()  # conflict detected by the shard-2 resolver
+    assert db[b"\x01aa"] == b"1" and db[b"\x85zz"] == b"new"
+
+
+def test_cancel(db):
+    tr = db.create_transaction()
+    tr[b"k"] = b"v"
+    tr.cancel()
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1025
+    assert db[b"k"] is None  # nothing was written
+
+
+def test_retry_limit_persists_across_retries(db):
+    db[b"k"] = b"0"
+    tr = db.create_transaction()
+    tr.options.set_retry_limit(2)
+    attempts = 0
+    with pytest.raises(FDBError):
+        while True:
+            attempts += 1
+            _ = tr[b"k"]
+            # another writer always wins before we commit
+            other = db.create_transaction()
+            other[b"k"] = b"%d" % attempts
+            other.commit()
+            tr[b"out"] = b"x"
+            try:
+                tr.commit()
+                break
+            except FDBError as e:
+                tr.on_error(e)
+    assert attempts == 3  # initial + 2 retries
+
+
+def test_system_keyspace_conflicts_with_sharded_resolvers(db):
+    dbs = Cluster(n_resolvers=2, **TEST_KNOBS).database()
+    key = b"\xff\xff\xffzz"
+    dbs[key] = b"0"
+    t1 = dbs.create_transaction()
+    _ = t1[key]
+    t2 = dbs.create_transaction()
+    t2[key] = b"2"
+    t2.commit()
+    t1[key] = b"1"
+    with pytest.raises(FDBError):
+        t1.commit()  # must NOT slip past the last shard's clip bound
