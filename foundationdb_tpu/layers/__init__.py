@@ -1,0 +1,4 @@
+from foundationdb_tpu.layers import tuple as tuple_layer  # noqa: F401
+from foundationdb_tpu.layers.directory import DirectoryLayer, directory  # noqa: F401
+from foundationdb_tpu.layers.subspace import Subspace  # noqa: F401
+from foundationdb_tpu.layers.tenant import Tenant, TenantManagement  # noqa: F401

@@ -9,6 +9,17 @@ from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.txn.transaction import Transaction
 
 
+def retry_loop(tr, fn):
+    """The transactional retry protocol, shared by Database and Tenant."""
+    while True:
+        try:
+            result = fn(tr)
+            tr.commit()
+            return result
+        except FDBError as e:
+            tr.on_error(e)  # re-raises when not retryable
+
+
 class Database:
     def __init__(self, cluster):
         self._cluster = cluster
@@ -19,14 +30,7 @@ class Database:
 
     def run(self, fn):
         """Execute ``fn(tr)`` transactionally with automatic retries."""
-        tr = self.create_transaction()
-        while True:
-            try:
-                result = fn(tr)
-                tr.commit()
-                return result
-            except FDBError as e:
-                tr.on_error(e)  # re-raises when not retryable
+        return retry_loop(self.create_transaction(), fn)
 
     transact = run
 
@@ -77,6 +81,11 @@ class Database:
             self.clear_range(key.start, key.stop)
         else:
             self.clear(key)
+
+    def open_tenant(self, name):
+        from foundationdb_tpu.layers.tenant import Tenant
+
+        return Tenant(self, name)
 
     def status(self):
         return self._cluster.status()

@@ -1,0 +1,210 @@
+"""Subspace / Directory / Tenant layers over a live in-process cluster."""
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.layers import tuple as fdbtuple
+from foundationdb_tpu.layers.directory import DirectoryLayer
+from foundationdb_tpu.layers.subspace import Subspace
+from foundationdb_tpu.layers.tenant import Tenant, TenantManagement
+from foundationdb_tpu.server.cluster import Cluster
+
+
+@pytest.fixture()
+def db():
+    return Cluster(resolver_backend="cpu").database()
+
+
+# ───────────────────────────── subspace ─────────────────────────────────
+def test_subspace_pack_unpack():
+    s = Subspace(("users",))
+    key = s.pack((42, "bob"))
+    assert s.contains(key)
+    assert s.unpack(key) == (42, "bob")
+    nested = s["prefs"]
+    assert nested.unpack(nested.pack((1,))) == (1,)
+    assert nested.raw_prefix.startswith(s.raw_prefix)
+    with pytest.raises(ValueError):
+        s.unpack(b"elsewhere")
+
+
+def test_subspace_range_scopes_reads(db):
+    users = Subspace(("u",))
+    other = Subspace(("v",))
+    db.set(users.pack((1,)), b"a")
+    db.set(users.pack((2,)), b"b")
+    db.set(other.pack((1,)), b"x")
+    rows = db.get_range(*users.range())
+    assert [users.unpack(k) for k, _ in rows] == [(1,), (2,)]
+
+
+# ───────────────────────────── directory ────────────────────────────────
+def test_directory_create_open_list(db):
+    dl = DirectoryLayer()
+    app = db.run(lambda tr: dl.create_or_open(tr, ("app",)))
+    users = db.run(lambda tr: dl.create_or_open(tr, ("app", "users")))
+    again = db.run(lambda tr: dl.open(tr, ("app", "users")))
+    assert users.key() == again.key()
+    assert users.get_path() == ("app", "users")
+    assert db.run(lambda tr: dl.list(tr, ("app",))) == ["users"]
+    assert db.run(lambda tr: dl.exists(tr, ("app", "users")))
+    assert not db.run(lambda tr: dl.exists(tr, ("nope",)))
+    # content prefixes are disjoint
+    assert not users.key().startswith(app.key())
+    assert not app.key().startswith(users.key())
+
+
+def test_directory_create_conflicts(db):
+    dl = DirectoryLayer()
+    db.run(lambda tr: dl.create(tr, ("a",)))
+    with pytest.raises(ValueError):
+        db.run(lambda tr: dl.create(tr, ("a",)))
+    with pytest.raises(ValueError):
+        db.run(lambda tr: dl.open(tr, ("missing",)))
+
+
+def test_directory_layer_tag(db):
+    dl = DirectoryLayer()
+    db.run(lambda tr: dl.create(tr, ("q",), layer=b"queue"))
+    opened = db.run(lambda tr: dl.open(tr, ("q",), layer=b"queue"))
+    assert opened.get_layer() == b"queue"
+    with pytest.raises(ValueError):
+        db.run(lambda tr: dl.open(tr, ("q",), layer=b"other"))
+
+
+def test_directory_move_and_remove(db):
+    dl = DirectoryLayer()
+    d = db.run(lambda tr: dl.create(tr, ("old", "leaf")))
+    db.set(d.pack(("k",)), b"v")
+    moved = db.run(lambda tr: dl.move(tr, ("old", "leaf"), ("new",)))
+    assert moved.key() == d.key()  # prefix (and data) survives the move
+    assert db.get(moved.pack(("k",))) == b"v"
+    assert not db.run(lambda tr: dl.exists(tr, ("old", "leaf")))
+    assert db.run(lambda tr: dl.remove(tr, ("new",)))
+    assert db.get(moved.pack(("k",))) is None
+    assert not db.run(lambda tr: dl.remove_if_exists(tr, ("new",)))
+
+
+def test_directory_remove_is_recursive(db):
+    dl = DirectoryLayer()
+    parent = db.run(lambda tr: dl.create(tr, ("p",)))
+    child = db.run(lambda tr: dl.create(tr, ("p", "c")))
+    db.set(child.pack(("k",)), b"v")
+    db.run(lambda tr: dl.remove(tr, ("p",)))
+    assert db.get(child.pack(("k",))) is None
+    assert not db.run(lambda tr: dl.exists(tr, ("p",)))
+    assert not db.run(lambda tr: dl.exists(tr, ("p", "c")))
+
+
+def test_hca_unique_prefixes(db):
+    dl = DirectoryLayer()
+    dirs = [db.run(lambda tr, i=i: dl.create(tr, (f"d{i}",))) for i in range(40)]
+    prefixes = [d.key() for d in dirs]
+    assert len(set(prefixes)) == 40
+    for a in prefixes:
+        for b in prefixes:
+            if a != b:
+                assert not a.startswith(b)
+
+
+def test_hca_concurrent_allocators_conflict(db):
+    """Two interleaved transactions must never commit the same prefix
+    (the claim read is conflicting, so OCC serializes them)."""
+    dl = DirectoryLayer()
+    db.run(lambda tr: dl.create(tr, ("seed",)))  # initialize version + hca
+    tr1 = db.create_transaction()
+    tr2 = db.create_transaction()
+    p1 = dl._allocator.allocate(tr1)
+    # force the same candidate draw for the second allocator
+    state = dl._allocator._rng.getstate()
+    dl._allocator._rng.setstate(state)
+    p2 = dl._allocator.allocate(tr2)
+    tr1.commit()
+    if p1 == p2:
+        with pytest.raises(FDBError) as ei:
+            tr2.commit()
+        assert ei.value.code == 1020  # not_committed
+    else:
+        tr2.commit()  # different candidates: both fine
+
+
+# ────────────────────────────── tenants ─────────────────────────────────
+def test_tenant_isolation(db):
+    TenantManagement.create_tenant(db, b"alice")
+    TenantManagement.create_tenant(db, b"bob")
+    alice = db.open_tenant(b"alice")
+    bob = db.open_tenant(b"bob")
+    alice[b"k"] = b"A"
+    bob[b"k"] = b"B"
+    assert alice[b"k"] == b"A"
+    assert bob[b"k"] == b"B"
+    assert db.get(b"k") is None  # raw keyspace unaffected
+    assert alice.get_range(None, None) == [(b"k", b"A")]
+
+
+def test_tenant_management_errors(db):
+    TenantManagement.create_tenant(db, b"t")
+    with pytest.raises(FDBError) as ei:
+        TenantManagement.create_tenant(db, b"t")
+    assert ei.value.description == "tenant_already_exists"
+    t = db.open_tenant(b"t")
+    t[b"x"] = b"1"
+    with pytest.raises(FDBError) as ei:
+        TenantManagement.delete_tenant(db, b"t")
+    assert ei.value.description == "tenant_not_empty"
+    t.clear(b"x")
+    TenantManagement.delete_tenant(db, b"t")
+    with pytest.raises(FDBError) as ei:
+        db.open_tenant(b"t").get(b"x")
+    assert ei.value.description == "tenant_not_found"
+    names = [n for n, _ in TenantManagement.list_tenants(db)]
+    assert b"t" not in names
+
+
+def test_tenant_stale_handle_cannot_write_dead_prefix(db):
+    """A handle that outlives delete+recreate must see the new prefix,
+    never silently write into the orphaned old keyspace."""
+    TenantManagement.create_tenant(db, b"t")
+    stale = db.open_tenant(b"t")
+    stale[b"x"] = b"old"  # resolves + uses prefix A
+    stale.clear(b"x")
+    TenantManagement.delete_tenant(db, b"t")
+    TenantManagement.create_tenant(db, b"t")  # rebinds name to prefix B
+    stale[b"y"] = b"new"  # must land in prefix B
+    fresh = db.open_tenant(b"t")
+    assert fresh[b"y"] == b"new"
+
+
+def test_tenant_rejects_system_keys(db):
+    TenantManagement.create_tenant(db, b"t2")
+    t = db.open_tenant(b"t2")
+    with pytest.raises(FDBError) as ei:
+        t.set(b"\xff\x01", b"v")
+    assert ei.value.description == "key_outside_legal_range"
+
+
+def test_tenant_transactional_and_conflicts(db):
+    TenantManagement.create_tenant(db, b"shop")
+    shop = db.open_tenant(b"shop")
+    shop[b"counter"] = (0).to_bytes(8, "little")
+
+    def bump(tr):
+        cur = int.from_bytes(tr.get(b"counter"), "little")
+        tr.set(b"counter", (cur + 1).to_bytes(8, "little"))
+
+    for _ in range(5):
+        shop.run(bump)
+    assert int.from_bytes(shop[b"counter"], "little") == 5
+
+
+def test_tenant_directory_inside(db):
+    """Layers compose: a directory tree scoped inside one tenant."""
+    TenantManagement.create_tenant(db, b"org")
+    org = db.open_tenant(b"org")
+    dl = DirectoryLayer(
+        node_subspace=Subspace(raw_prefix=b"\xfe"), content_subspace=Subspace()
+    )
+    d = org.run(lambda tr: dl.create_or_open(tr, ("inbox",)))
+    org.run(lambda tr: tr.set(d.pack((1,)), b"mail"))
+    assert org.run(lambda tr: tr.get(d.pack((1,)))) == b"mail"
+    assert db.get(d.pack((1,))) is None  # invisible outside the tenant

@@ -1,0 +1,183 @@
+"""Tuple layer: round-trip + order preservation (SURVEY §4.1)."""
+
+import random
+import struct
+import uuid
+
+import pytest
+
+from foundationdb_tpu.core.versions import Versionstamp
+from foundationdb_tpu.layers import tuple as fdbtuple
+from foundationdb_tpu.layers.tuple import SingleFloat, pack, unpack
+
+
+def rand_element(rng, depth=0):
+    choices = ["null", "bytes", "str", "int", "float", "bool", "uuid"]
+    if depth < 2:
+        choices.append("nested")
+    kind = rng.choice(choices)
+    if kind == "null":
+        return None
+    if kind == "bytes":
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 12)))
+    if kind == "str":
+        return "".join(rng.choice("aé中\x01z0") for _ in range(rng.randrange(0, 8)))
+    if kind == "int":
+        mag = rng.choice([0, 1, 255, 256, 2**31, 2**63, 2**70])
+        v = rng.randrange(mag + 1) if mag else 0
+        return -v if rng.random() < 0.5 else v
+    if kind == "float":
+        return rng.choice([0.0, -0.0, 1.5, -2.25, 1e300, -1e-300, float("inf")])
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "uuid":
+        return uuid.UUID(bytes=bytes(rng.randrange(256) for _ in range(16)))
+    return tuple(rand_element(rng, depth + 1) for _ in range(rng.randrange(0, 3)))
+
+
+def test_round_trip_exhaustive_smoke():
+    t = (
+        None,
+        b"bytes\x00embedded",
+        "stri\x00ng",
+        0,
+        1,
+        -1,
+        255,
+        -255,
+        2**40,
+        -(2**40),
+        2**70,
+        -(2**70),
+        3.14,
+        -3.14,
+        SingleFloat(1.5),
+        True,
+        False,
+        uuid.uuid5(uuid.NAMESPACE_DNS, "fdb"),
+        (1, (None, b"n"), "x"),
+        Versionstamp.from_version(12345, 7),
+    )
+    assert unpack(pack(t)) == t
+
+
+def test_round_trip_random():
+    rng = random.Random(7)
+    for _ in range(500):
+        t = tuple(rand_element(rng) for _ in range(rng.randrange(0, 5)))
+        assert unpack(pack(t)) == t
+
+
+def _type_rank(v):
+    # spec ordering: null < bytes < str < nested < int < float < bool < uuid < vs
+    if v is None:
+        return 0
+    if isinstance(v, bytes):
+        return 1
+    if isinstance(v, str):
+        return 2
+    if isinstance(v, tuple):
+        return 3
+    if isinstance(v, bool):
+        return 6
+    if isinstance(v, int):
+        return 4
+    if isinstance(v, (float, SingleFloat)):
+        return 5
+    if isinstance(v, uuid.UUID):
+        return 7
+    return 8
+
+
+def _sem_key(t):
+    out = []
+    for v in t:
+        r = _type_rank(v)
+        if isinstance(v, tuple):
+            out.append((r, _sem_key(v)))
+        elif isinstance(v, SingleFloat):
+            # cross-width float ordering mixes fp32/fp64 payloads; rank only
+            out.append((r, ("f32", struct.pack(">f", v.value))))
+        elif isinstance(v, float):
+            out.append((r, ("f64", struct.pack(">d", v))))
+        elif v is None:
+            out.append((r, 0))
+        elif isinstance(v, uuid.UUID):
+            out.append((r, v.bytes))
+        elif isinstance(v, Versionstamp):
+            out.append((r, v.to_bytes()))
+        else:
+            out.append((r, v))
+    return tuple(out)
+
+
+def test_order_preservation_ints():
+    vals = sorted(
+        {0, 1, -1, 2, 255, 256, -255, -256, 2**32, -(2**32), 2**64 + 5, -(2**64 + 5)}
+    )
+    packed = [pack((v,)) for v in vals]
+    assert packed == sorted(packed)
+
+
+def test_order_preservation_floats():
+    vals = sorted([-1e300, -2.5, -0.0, 0.0, 1e-300, 2.5, 1e300, float("inf"), -float("inf")])
+    packed = [pack((v,)) for v in vals]
+    assert packed == sorted(packed)
+
+
+def test_order_preservation_bytes_and_strings():
+    rng = random.Random(11)
+    vals = sorted(bytes(rng.randrange(256) for _ in range(rng.randrange(0, 6))) for _ in range(200))
+    packed = [pack((v,)) for v in vals]
+    assert packed == sorted(packed)
+
+
+def test_order_preservation_random_same_type():
+    rng = random.Random(3)
+    ints = sorted(rng.randrange(-(2**66), 2**66) for _ in range(300))
+    packed = [pack((v,)) for v in ints]
+    assert packed == sorted(packed)
+
+
+def test_type_order_is_spec_order():
+    samples = [None, b"a", "a", (1,), 5, 2.5, True, uuid.UUID(int=3)]
+    packed = [pack((v,)) for v in samples]
+    assert packed == sorted(packed)
+
+
+def test_range():
+    b, e = fdbtuple.range(("app", 7))
+    assert b == pack(("app", 7)) + b"\x00"
+    assert e == pack(("app", 7)) + b"\xff"
+    inside = pack(("app", 7, "x"))
+    assert b <= inside < e
+    outside = pack(("app", 8))
+    assert not (b <= outside < e)
+
+
+def test_prefix_pack():
+    assert pack((1, 2), prefix=b"P") == b"P" + pack((1, 2))
+    assert unpack(pack((1, 2), prefix=b"P"), prefix_len=1) == (1, 2)
+
+
+def test_pack_with_versionstamp():
+    vs = Versionstamp()
+    packed = fdbtuple.pack_with_versionstamp(("k", vs), prefix=b"PP")
+    offset = struct.unpack("<I", packed[-4:])[0]
+    # offset points at the 10-byte placeholder
+    assert packed[offset : offset + 10] == b"\xff" * 10
+    with pytest.raises(ValueError):
+        fdbtuple.pack_with_versionstamp(("k", vs, vs))
+    with pytest.raises(ValueError):
+        fdbtuple.pack_with_versionstamp(("k",))
+    assert fdbtuple.has_incomplete_versionstamp(("a", (vs,)))
+    assert not fdbtuple.has_incomplete_versionstamp(("a", Versionstamp.from_version(1)))
+
+
+def test_nested_null_escaping():
+    t = ((None, b"\x00", None),)
+    assert unpack(pack(t)) == t
+    # nested tuple with nulls must still sort before a longer sibling
+    a = pack(((None,),))
+    b = pack(((None, None),))
+    assert a < b
