@@ -73,9 +73,7 @@ class HighContentionAllocator:
                 # other's read) — a snapshot read here would let both
                 # commit the same prefix
                 if tr.get(key) is None:
-                    tr.options.set_next_write_no_write_conflict_range()
                     tr.set(key, b"")
-                    tr.add_write_conflict_key(key)
                     return fdbtuple.pack((candidate,))
 
     @staticmethod

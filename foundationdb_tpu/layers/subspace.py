@@ -28,8 +28,7 @@ class Subspace:
         return fdbtuple.unpack(key, prefix_len=len(self.raw_prefix))
 
     def range(self, t=()):
-        p = fdbtuple.pack(tuple(t), prefix=self.raw_prefix)
-        return p + b"\x00", p + b"\xff"
+        return fdbtuple.range(tuple(t), prefix=self.raw_prefix)
 
     def contains(self, key):
         return bytes(key).startswith(self.raw_prefix)

@@ -139,9 +139,18 @@ class TenantTransaction:
     def _out(self, key):
         return bytes(key)[len(self._p):]
 
+    def _in_end(self, key):
+        """Exclusive end bound: clamp system-space ends to the tenant's
+        upper edge instead of rejecting (an end bound is never accessed,
+        and b'' .. b'\\xff' is the standard full-scan idiom)."""
+        key = bytes(key)
+        if key.startswith(b"\xff"):
+            return strinc(self._p)
+        return self._p + key
+
     def _range(self, begin, end):
         b = self._p if begin is None else self._in(begin)
-        e = strinc(self._p) if end is None else self._in(end)
+        e = strinc(self._p) if end is None else self._in_end(end)
         return b, e
 
     # reads
@@ -154,7 +163,7 @@ class TenantTransaction:
 
     def get_range_startswith(self, prefix, **kw):
         prefix = bytes(prefix)
-        return self.get_range(prefix, strinc(prefix) if prefix else None, **kw)
+        return self.get_range(prefix or None, strinc(prefix) if prefix else None, **kw)
 
     def get_read_version(self):
         return self._tr.get_read_version()
@@ -214,10 +223,10 @@ class TenantTransaction:
         self._tr.add_write_conflict_key(self._in(key))
 
     def add_read_conflict_range(self, begin, end):
-        self._tr.add_read_conflict_range(self._in(begin), self._in(end))
+        self._tr.add_read_conflict_range(self._in(begin), self._in_end(end))
 
     def add_write_conflict_range(self, begin, end):
-        self._tr.add_write_conflict_range(self._in(begin), self._in(end))
+        self._tr.add_write_conflict_range(self._in(begin), self._in_end(end))
 
     def watch(self, key):
         return self._tr.watch(self._in(key))

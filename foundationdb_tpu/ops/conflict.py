@@ -395,7 +395,9 @@ def resolve_batch(
         range_R = pmax_arr(range_R)
 
     new_state = ResolverState(
-        window_start=batch.new_window_start,
+        # monotone: never regress the window (a recovered resolver's fence
+        # must survive proxies whose cv-derived window is still behind it)
+        window_start=jnp.maximum(state.window_start, batch.new_window_start),
         ht=ht,
         ring_b=ring_b,
         ring_e=ring_e,

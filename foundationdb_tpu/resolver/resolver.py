@@ -44,6 +44,7 @@ class Resolver:
             self._resolve = ck.make_resolve_fn(self.params)
         elif self.backend == "cpu":
             self.cset = CpuConflictSet()
+            self.cset.window_start = base_version
         else:
             raise ValueError(f"unknown resolver_backend {self.backend!r}")
 

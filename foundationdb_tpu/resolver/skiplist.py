@@ -82,8 +82,11 @@ class CpuConflictSet:
         return statuses
 
     def set_oldest_version(self, version):
-        """Advance the MVCC window; prune entries no read can see anymore."""
-        self.window_start = version
+        """Advance the MVCC window; prune entries no read can see anymore.
+        Monotone: a recovered resolver's fence (window at the recovery
+        version) must not regress when the proxy's cv-derived window is
+        still behind it."""
+        self.window_start = max(self.window_start, version)
         self._ops_since_prune += 1
         if self._ops_since_prune >= 64:
             self._ops_since_prune = 0

@@ -23,7 +23,8 @@ from foundationdb_tpu.server.tlog import TLog
 
 class Cluster:
     def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
-                 version_clock="counter", **knob_overrides):
+                 version_clock="counter", storage_engines=None,
+                 **knob_overrides):
         if knobs is None:
             knobs = (
                 dataclasses.replace(DEFAULT_KNOBS, **knob_overrides)
@@ -31,13 +32,43 @@ class Cluster:
                 else DEFAULT_KNOBS
             )
         self.knobs = knobs
-        self.sequencer = Sequencer(version_clock=version_clock)
         self.ratekeeper = Ratekeeper()
-        self.resolvers = [Resolver(knobs) for _ in range(n_resolvers)]
-        self.tlog = TLog(wal_path=wal_path)
+        if storage_engines is None:
+            storage_engines = [None] * n_storage
+        elif len(storage_engines) != n_storage:
+            if n_storage != 1:
+                raise ValueError(
+                    f"n_storage={n_storage} but {len(storage_engines)} "
+                    "storage_engines given"
+                )
+            n_storage = len(storage_engines)
         self.storages = [
-            StorageServer(window_versions=knobs.max_read_transaction_life_versions)
-            for _ in range(n_storage)
+            StorageServer(
+                window_versions=knobs.max_read_transaction_life_versions,
+                engine=eng,
+            )
+            for eng in storage_engines
+        ]
+        # ── recovery (ref: Master recovery replaying tlogs into storage) ──
+        # Replay WAL records newer than each storage's durable version,
+        # then restart the version authority above everything recovered.
+        # Conflict history is not persisted; instead the resolvers open
+        # with their window starting at the recovered version, so any
+        # read version from before the crash is rejected TOO_OLD — the
+        # same effect as the reference's recovery fencing in-flight txns.
+        recovered_records = TLog.recover(wal_path) if wal_path else []
+        for s in self.storages:
+            for version, mutations in recovered_records:
+                if version > s.version:
+                    s.apply(version, mutations)
+        recovered = max((s.version for s in self.storages), default=0)
+        self.tlog = TLog(wal_path=wal_path)
+        self.tlog._first_version = recovered
+        self.sequencer = Sequencer(
+            version_clock=version_clock, start_version=recovered
+        )
+        self.resolvers = [
+            Resolver(knobs, base_version=recovered) for _ in range(n_resolvers)
         ]
         self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
         self.commit_proxy = CommitProxy(

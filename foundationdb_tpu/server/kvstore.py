@@ -1,0 +1,232 @@
+"""Pluggable persistent storage engines beneath the storage server.
+
+Ref parity: fdbserver/IKeyValueStore.h and its implementations —
+KeyValueStoreMemory.actor.cpp (in-RAM tree + operation log for
+durability) and KeyValueStoreSQLite.actor.cpp (B-tree file). The storage
+server (server/storage.py) keeps the MVCC window as an in-memory overlay
+and flushes versions leaving the window down into one of these engines,
+advancing its *durable version* behind the *latest version* exactly like
+the reference.
+
+Engines are single-version: they store the state as of the durable
+version. ``commit(version)`` makes everything written so far durable and
+records the version (recovered by ``stored_version()`` after restart).
+"""
+
+import os
+import pickle
+import sqlite3
+import struct
+import zlib
+
+from sortedcontainers import SortedDict
+
+_META_VERSION_KEY = b"\xff\xff/kvstore_version"
+
+
+class KeyValueStoreMemory:
+    """Ordered in-RAM map, optionally durable via snapshot + op WAL.
+
+    Ref: KeyValueStoreMemory — every mutation is logged to a DiskQueue;
+    a periodic snapshot bounds replay. Recovery = load snapshot, replay
+    the op log, tolerate a torn tail.
+    """
+
+    def __init__(self, path=None, fsync=False, snapshot_every_ops=50_000):
+        self._data = SortedDict()
+        self._version = 0
+        self.path = path
+        self.fsync = fsync
+        self._ops_since_snapshot = 0
+        self._snapshot_every = snapshot_every_ops
+        self._wal = None
+        if path is not None:
+            self._recover()
+            self._wal = open(self._wal_path, "ab")
+
+    @property
+    def _snap_path(self):
+        return self.path + ".snap"
+
+    @property
+    def _wal_path(self):
+        return self.path + ".oplog"
+
+    # ── reads ──
+    def get(self, key):
+        return self._data.get(key)
+
+    def get_range(self, begin, end, limit=0, reverse=False):
+        out = []
+        for kv in self.iter_range(begin, end, reverse=reverse):
+            out.append(kv)
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def iter_range(self, begin, end, reverse=False):
+        """Lazy ordered (key, value) iteration — the storage server merges
+        this under its overlay without materializing the range."""
+        for k in self._data.irange(begin, end, inclusive=(True, False), reverse=reverse):
+            yield k, self._data[k]
+
+    def stored_version(self):
+        return self._version
+
+    def __len__(self):
+        return len(self._data)
+
+    # ── writes ──
+    def set(self, key, value):
+        self._data[key] = value
+        self._log(("s", key, value))
+
+    def clear_range(self, begin, end):
+        for k in list(self._data.irange(begin, end, inclusive=(True, False))):
+            del self._data[k]
+        self._log(("c", begin, end))
+
+    def commit(self, version):
+        self._version = version
+        self._log(("v", version, None))
+        if self._wal is not None:
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            if self._ops_since_snapshot >= self._snapshot_every:
+                self.compact()
+
+    def _log(self, op):
+        if self._wal is None:
+            return
+        payload = pickle.dumps(op, protocol=4)
+        self._wal.write(struct.pack(">II", len(payload), zlib.crc32(payload)) + payload)
+        self._ops_since_snapshot += 1
+
+    def compact(self):
+        """Snapshot the full state and truncate the op log (ref: the memory
+        engine's periodic snapshot so recovery replay stays bounded)."""
+        if self.path is None:
+            return
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump((self._version, dict(self._data)), f, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+        self._ops_since_snapshot = 0
+
+    def _recover(self):
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                self._version, data = pickle.load(f)
+            self._data = SortedDict(data)
+        try:
+            with open(self._wal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        off = 0
+        while off + 8 <= len(raw):
+            ln, crc = struct.unpack_from(">II", raw, off)
+            if off + 8 + ln > len(raw):
+                break  # torn tail
+            payload = raw[off + 8 : off + 8 + ln]
+            if zlib.crc32(payload) != crc:
+                break
+            kind, a, b = pickle.loads(payload)
+            if kind == "s":
+                self._data[a] = b
+            elif kind == "c":
+                for k in list(self._data.irange(a, b, inclusive=(True, False))):
+                    del self._data[k]
+            elif kind == "v":
+                self._version = a
+            off += 8 + ln
+
+    def close(self):
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+
+class KeyValueStoreSQLite:
+    """B-tree file engine on the stdlib sqlite3 (ref: KeyValueStoreSQLite —
+    the reference embeds the same sqlite B-tree, via its own pager)."""
+
+    def __init__(self, path, fsync=False):
+        self.path = path
+        self._conn = sqlite3.connect(path)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(f"PRAGMA synchronous={'FULL' if fsync else 'NORMAL'}")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB) WITHOUT ROWID"
+        )
+        self._conn.execute("CREATE TABLE IF NOT EXISTS meta (k BLOB PRIMARY KEY, v BLOB)")
+
+    def get(self, key):
+        row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def get_range(self, begin, end, limit=0, reverse=False):
+        q = "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k"
+        if reverse:
+            q += " DESC"
+        if limit:
+            q += f" LIMIT {int(limit)}"
+        return [
+            (bytes(k), bytes(v))
+            for k, v in self._conn.execute(q, (begin, end)).fetchall()
+        ]
+
+    def iter_range(self, begin, end, reverse=False):
+        q = "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k"
+        if reverse:
+            q += " DESC"
+        for k, v in self._conn.execute(q, (begin, end)):  # lazy cursor
+            yield bytes(k), bytes(v)
+
+    def stored_version(self):
+        row = self._conn.execute(
+            "SELECT v FROM meta WHERE k = ?", (_META_VERSION_KEY,)
+        ).fetchone()
+        return 0 if row is None else struct.unpack(">q", row[0])[0]
+
+    def __len__(self):
+        return self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+
+    def set(self, key, value):
+        self._conn.execute("INSERT OR REPLACE INTO kv VALUES (?, ?)", (key, value))
+
+    def clear_range(self, begin, end):
+        self._conn.execute("DELETE FROM kv WHERE k >= ? AND k < ?", (begin, end))
+
+    def commit(self, version):
+        self._conn.execute(
+            "INSERT OR REPLACE INTO meta VALUES (?, ?)",
+            (_META_VERSION_KEY, struct.pack(">q", version)),
+        )
+        self._conn.commit()
+
+    def compact(self):
+        self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+
+    def close(self):
+        self._conn.commit()
+        self._conn.close()
+
+
+ENGINES = {"memory": KeyValueStoreMemory, "sqlite": KeyValueStoreSQLite}
+
+
+def open_engine(kind, path=None, **kw):
+    if kind == "memory":
+        return KeyValueStoreMemory(path, **kw)
+    if kind == "sqlite":
+        if path is None:
+            raise ValueError("sqlite engine requires a path")
+        return KeyValueStoreSQLite(path, **kw)
+    raise ValueError(f"unknown storage engine {kind!r}")

@@ -1,18 +1,27 @@
 """Storage server: MVCC versioned reads over an ordered key space.
 
-Ref parity: fdbserver/storageserver.actor.cpp — serves reads at a client's
-read version within the 5s MVCC window, applies committed mutations in
-version order, resolves key selectors, supports watches. The reference
-layers a versioned in-memory tree over a persistent engine; here the
-versioned view is a SortedDict of per-key version chains over a pluggable
-KeyValueStore (server/kvstore.py) snapshot.
+Ref parity: fdbserver/storageserver.actor.cpp — serves reads at a
+client's read version within the 5s MVCC window, applies committed
+mutations in version order, resolves key selectors, supports watches.
+Mirrors the reference's two-tier design: a versioned in-memory overlay
+(PTree in the reference) holding the MVCC window, above a pluggable
+single-version persistent engine (server/kvstore.py) that stores the
+state as of the *durable version*. ``flush()`` advances the durable
+version by folding overlay versions into the engine, exactly like the
+reference's updateStorage loop making versions durable then popping the
+tlog.
 """
+
+from collections import deque
 
 from sortedcontainers import SortedDict
 
 from foundationdb_tpu.core.errors import err
-from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.core.keys import KeySelector, key_successor
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
+from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
+
+_MISS = object()  # overlay has no entry at-or-below the read version
 
 
 class Watch:
@@ -40,13 +49,27 @@ class Watch:
 
 
 class StorageServer:
-    def __init__(self, window_versions=5_000_000):
-        # key -> list[(version, value_or_None)] ascending; None = tombstone
-        self._data = SortedDict()
-        self.oldest_version = 0
-        self.version = 0  # latest applied
+    def __init__(self, window_versions=5_000_000, engine=None):
+        # overlay: key -> list[(version, value_or_None)] ascending, all
+        # versions > durable_version; None = tombstone
+        self._overlay = SortedDict()
+        self._dirty = deque()  # (version, key) in apply order, for flush
+        self.engine = engine if engine is not None else KeyValueStoreMemory()
+        self.durable_version = self.engine.stored_version()
+        self.oldest_version = self.durable_version
+        self.version = self.durable_version  # latest applied
         self.window_versions = window_versions
         self._watches = {}  # key -> list[Watch]
+
+    @classmethod
+    def recover(cls, engine, log_records, window_versions=5_000_000):
+        """Rebuild from a persistent engine + tlog records past its
+        durable version (ref: storage server recovery peeking the tlog)."""
+        ss = cls(window_versions=window_versions, engine=engine)
+        for version, mutations in log_records:
+            if version > ss.durable_version:
+                ss.apply(version, mutations)
+        return ss
 
     # ───────────────────────────── writes ──────────────────────────────
     def apply(self, version, mutations):
@@ -55,37 +78,78 @@ class StorageServer:
             raise ValueError(f"apply out of order: {version} <= {self.version}")
         for m in mutations:
             if m.op is Op.CLEAR_RANGE:
-                for k in list(self._data.irange(m.key, m.param, inclusive=(True, False))):
-                    self._append(k, version, None)
+                self._apply_clear_range(m.key, m.param, version)
             elif m.op in (Op.SET, Op.CLEAR):
                 self._append(m.key, version, m.param if m.op is Op.SET else None)
             elif m.op in ATOMIC_OPS:
-                old = self._read_chain(m.key, version)
+                old = self._lookup(m.key, version)
                 self._append(m.key, version, apply_atomic(m.op, old, m.param))
             else:
                 raise ValueError(f"unresolved mutation {m.op} reached storage")
         self.version = version
-        self.oldest_version = max(self.oldest_version, version - self.window_versions)
+
+    def _apply_clear_range(self, begin, end, version):
+        # tombstone every key the clear shadows: overlay keys in range plus
+        # engine (durable) keys in range not yet overlaid
+        keys = set(self._overlay.irange(begin, end, inclusive=(True, False)))
+        keys.update(k for k, _ in self.engine.get_range(begin, end))
+        for k in keys:
+            self._append(k, version, None)
 
     def _append(self, key, version, value):
-        chain = self._data.get(key)
+        chain = self._overlay.get(key)
         if chain is None:
             chain = []
-            self._data[key] = chain
+            self._overlay[key] = chain
         chain.append((version, value))
-        # prune chain entries older than the window (keep the newest <= oldest)
-        if len(chain) > 4:
-            cut = 0
-            for i, (v, _) in enumerate(chain):
-                if v <= self.oldest_version:
-                    cut = i
-            if cut:
-                del chain[:cut]
+        self._dirty.append((version, key))
         for w in self._watches.get(key, []):
             if value != w.seen_value:
                 w._fire()
         if self._watches.get(key):
             self._watches[key] = [w for w in self._watches[key] if not w.fired]
+
+    def flush(self, up_to_version=None):
+        """Make versions ≤ ``up_to_version`` durable: fold the newest
+        overlay entry at-or-below it into the engine, prune the overlay,
+        advance durable_version. Returns the new durable version."""
+        if up_to_version is None:
+            up_to_version = self.version
+        up_to_version = min(up_to_version, self.version)
+        if up_to_version <= self.durable_version:
+            return self.durable_version
+        # the dirty queue is version-ordered, so flushing touches only keys
+        # actually written at-or-below the target (ref: the version-ordered
+        # update queue in the storage server's updateStorage loop)
+        touched = set()
+        while self._dirty and self._dirty[0][0] <= up_to_version:
+            touched.add(self._dirty.popleft()[1])
+        for key in touched:
+            chain = self._overlay.get(key)
+            if chain is None:
+                continue
+            folded = _MISS
+            keep = []
+            for v, val in chain:
+                if v <= up_to_version:
+                    folded = val
+                else:
+                    keep.append((v, val))
+            if folded is not _MISS:
+                if folded is None:
+                    self.engine.clear_range(key, key_successor(key))
+                else:
+                    self.engine.set(key, folded)
+            if keep:
+                self._overlay[key] = keep
+            else:
+                del self._overlay[key]
+        self.engine.commit(up_to_version)
+        self.durable_version = up_to_version
+        # reads below the durable version can no longer be served (the
+        # engine is single-version); keep the window invariant tight
+        self.oldest_version = max(self.oldest_version, up_to_version)
+        return self.durable_version
 
     # ───────────────────────────── reads ───────────────────────────────
     def _check_version(self, version):
@@ -94,48 +158,92 @@ class StorageServer:
         if version > self.version:
             raise err("future_version")
 
-    def _read_chain(self, key, version):
-        chain = self._data.get(key)
-        if not chain:
-            return None
-        val = None
-        for v, x in chain:
+    def _lookup(self, key, version):
+        """Value of key at version (overlay first, engine beneath)."""
+        chain = self._overlay.get(key)
+        if chain:
+            val = _MISS
+            for v, x in chain:
+                if v <= version:
+                    val = x
+                else:
+                    break
+            if val is not _MISS:
+                return val
+        return self.engine.get(key)
+
+    def get(self, key, version):
+        self._check_version(version)
+        return self._lookup(key, version)
+
+    def _overlay_at(self, key, version):
+        """Newest overlay value at-or-below ``version`` (or _MISS)."""
+        val = _MISS
+        for v, x in self._overlay.get(key, ()):
             if v <= version:
                 val = x
             else:
                 break
         return val
 
-    def get(self, key, version):
-        self._check_version(version)
-        return self._read_chain(key, version)
+    def _iter_live(self, begin, end, version, reverse=False):
+        """Lazy merged (key, value) iteration of engine + overlay at
+        ``version`` — overlay wins ties; pulls the engine cursor only as
+        far as the caller consumes (limit pushdown)."""
+        sentinel = object()
+        ov = iter(self._overlay.irange(begin, end, inclusive=(True, False), reverse=reverse))
+        base = self.engine.iter_range(begin, end, reverse=reverse)
+        ko = next(ov, sentinel)
+        kb = next(base, sentinel)
+        while ko is not sentinel or kb is not sentinel:
+            if kb is sentinel:
+                take_overlay = True
+            elif ko is sentinel:
+                take_overlay = False
+            elif ko == kb[0]:
+                # same key in both: overlay decides if it has an entry
+                val = self._overlay_at(ko, version)
+                if val is _MISS:
+                    val = kb[1]
+                if val is not None:
+                    yield ko, val
+                ko = next(ov, sentinel)
+                kb = next(base, sentinel)
+                continue
+            else:
+                take_overlay = (ko < kb[0]) != reverse
+            if take_overlay:
+                val = self._overlay_at(ko, version)
+                if val is not _MISS and val is not None:
+                    yield ko, val
+                ko = next(ov, sentinel)
+            else:
+                yield kb
+                kb = next(base, sentinel)
 
     def _live_keys(self, begin, end, version, reverse=False):
-        it = self._data.irange(begin, end, inclusive=(True, False), reverse=reverse)
-        for k in it:
-            if self._read_chain(k, version) is not None:
-                yield k
+        for k, _ in self._iter_live(begin, end, version, reverse=reverse):
+            yield k
 
     def resolve_selector(self, sel: KeySelector, version):
         """Resolve a key selector to a concrete key (ref: storageserver
         findKey): start at the last live key < (or <=) sel.key, then move
         ``offset`` live keys right. Clamps to b'' / \\xff sentinel."""
+        import itertools
+
         self._check_version(version)
-        base_idx = None  # index among live keys, conceptually
-        # walk from the reference key
-        if sel.or_equal:
-            prev = list(self._live_keys(b"", sel.key + b"\x00", version, reverse=True))
-        else:
-            prev = list(self._live_keys(b"", sel.key, version, reverse=True))
         offset = sel.offset
+        upper = sel.key + b"\x00" if sel.or_equal else sel.key
+        # lazily walk left from the reference key, taking only what the
+        # offset needs (the reference does the same bounded walk in findKey)
+        need = 1 if offset > 0 else (-offset + 1)
+        prev = list(
+            itertools.islice(self._live_keys(b"", upper, version, reverse=True), need)
+        )
         if offset > 0:
             start = prev[0] + b"\x00" if prev else b""
             following = self._live_keys(start, b"\xff\xff", version)
-            k = None
-            for i, kk in enumerate(following, start=1):
-                if i == offset:
-                    k = kk
-                    break
+            k = next(itertools.islice(following, offset - 1, None), None)
             return k if k is not None else b"\xff"
         else:
             # offset 0 => last-less-than(-or-equal); negative walks left
@@ -152,8 +260,8 @@ class StorageServer:
         if begin > end:
             return []
         out = []
-        for k in self._live_keys(begin, end, version, reverse=reverse):
-            out.append((k, self._read_chain(k, version)))
+        for kv in self._iter_live(begin, end, version, reverse=reverse):
+            out.append(kv)
             if limit and len(out) >= limit:
                 break
         return out
@@ -161,7 +269,7 @@ class StorageServer:
     # ───────────────────────────── watches ─────────────────────────────
     def watch(self, key, seen_value):
         w = Watch(key, seen_value)
-        current = self._read_chain(key, self.version)
+        current = self._lookup(key, self.version)
         if current != seen_value:
             w._fire()
         else:
@@ -170,3 +278,8 @@ class StorageServer:
 
     def advance_window(self, oldest):
         self.oldest_version = max(self.oldest_version, oldest)
+        # keep the durable tier within the window so overlay memory stays
+        # bounded even without an explicit flush schedule
+        if self.oldest_version > self.durable_version:
+            self.flush(self.oldest_version)
+

@@ -1,0 +1,244 @@
+"""Storage engines + the storage server's durable-version tiering."""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.core.mutations import Mutation, Op
+from foundationdb_tpu.server.kvstore import (
+    KeyValueStoreMemory,
+    KeyValueStoreSQLite,
+    open_engine,
+)
+from foundationdb_tpu.server.storage import StorageServer
+from foundationdb_tpu.server.tlog import TLog
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def engine_factory(request, tmp_path):
+    kind = request.param
+    counter = [0]
+
+    def make(name=None):
+        counter[0] += 1
+        path = str(tmp_path / f"{kind}{name or counter[0]}")
+        return open_engine(kind, path)
+
+    return make
+
+
+# ───────────────────────────── engines ──────────────────────────────────
+def test_engine_basic_ops(engine_factory):
+    e = engine_factory()
+    e.set(b"a", b"1")
+    e.set(b"b", b"2")
+    e.set(b"c", b"3")
+    assert e.get(b"b") == b"2"
+    assert e.get(b"zz") is None
+    assert e.get_range(b"a", b"c") == [(b"a", b"1"), (b"b", b"2")]
+    assert e.get_range(b"a", b"z", reverse=True, limit=2) == [(b"c", b"3"), (b"b", b"2")]
+    e.clear_range(b"a", b"b\x00")
+    assert e.get_range(b"", b"\xff") == [(b"c", b"3")]
+    e.commit(42)
+    assert e.stored_version() == 42
+    e.close()
+
+
+def test_engine_durability(engine_factory):
+    e = engine_factory("dur")
+    path = e.path
+    for i in range(100):
+        e.set(b"k%03d" % i, b"v%d" % i)
+    e.clear_range(b"k050", b"k060")
+    e.commit(7)
+    e.close()
+    e2 = open_engine(type(e).__name__ == "KeyValueStoreSQLite" and "sqlite" or "memory", path)
+    assert e2.stored_version() == 7
+    assert e2.get(b"k000") == b"v0"
+    assert e2.get(b"k055") is None
+    assert len(e2) == 90
+    e2.close()
+
+
+def test_memory_engine_snapshot_compaction(tmp_path):
+    path = str(tmp_path / "m")
+    e = KeyValueStoreMemory(path)
+    for i in range(10):
+        e.set(b"%d" % i, b"x")
+    e.commit(1)
+    e.compact()
+    e.set(b"post", b"y")
+    e.commit(2)
+    e.close()
+    e2 = KeyValueStoreMemory(path)
+    assert e2.stored_version() == 2
+    assert e2.get(b"post") == b"y"
+    assert e2.get(b"0") == b"x"
+    e2.close()
+
+
+def test_memory_engine_torn_tail(tmp_path):
+    path = str(tmp_path / "torn")
+    e = KeyValueStoreMemory(path)
+    e.set(b"a", b"1")
+    e.commit(1)
+    e.close()
+    with open(path + ".oplog", "ab") as f:
+        f.write(b"\x00\x00\x00\x99GARBAGE")  # truncated record
+    e2 = KeyValueStoreMemory(path)
+    assert e2.get(b"a") == b"1"
+    assert e2.stored_version() == 1
+    e2.close()
+
+
+# ──────────────────────── storage server tiering ────────────────────────
+def _set(k, v):
+    return Mutation(Op.SET, k, v)
+
+
+def _clr(b, e):
+    return Mutation(Op.CLEAR_RANGE, b, e)
+
+
+def test_storage_flush_moves_data_to_engine():
+    ss = StorageServer()
+    ss.apply(10, [_set(b"a", b"1"), _set(b"b", b"2")])
+    ss.apply(20, [_set(b"a", b"1.1"), _clr(b"b", b"c")])
+    assert ss.get(b"a", 15) == b"1"
+    ss.flush(10)
+    assert ss.durable_version == 10
+    assert ss.engine.get(b"a") == b"1" and ss.engine.get(b"b") == b"2"
+    # reads at/after the durable version still see the overlay
+    assert ss.get(b"a", 20) == b"1.1"
+    assert ss.get(b"b", 20) is None
+    ss.flush()
+    assert ss.engine.get(b"a") == b"1.1"
+    assert ss.engine.get(b"b") is None
+    # read below durable version now rejected
+    with pytest.raises(FDBError):
+        ss.get(b"a", 5)
+
+
+def test_storage_clear_range_shadows_engine_keys():
+    ss = StorageServer()
+    ss.apply(10, [_set(b"k1", b"a"), _set(b"k2", b"b"), _set(b"k3", b"c")])
+    ss.flush(10)
+    assert ss._overlay == {}
+    ss.apply(20, [_clr(b"k1", b"k3")])
+    assert ss.get(b"k1", 20) is None
+    assert ss.get(b"k2", 20) is None
+    assert ss.get(b"k3", 20) == b"c"
+    assert ss.get_range(b"", b"\xff", 20) == [(b"k3", b"c")]
+
+
+def test_storage_range_and_selectors_merge_tiers():
+    ss = StorageServer()
+    ss.apply(10, [_set(b"a", b"1"), _set(b"c", b"3")])
+    ss.flush(10)
+    ss.apply(20, [_set(b"b", b"2"), _set(b"a", b"1.1")])
+    assert ss.get_range(b"", b"\xff", 20) == [
+        (b"a", b"1.1"), (b"b", b"2"), (b"c", b"3")
+    ]
+    assert ss.get_range(b"", b"\xff", 20, reverse=True, limit=2) == [
+        (b"c", b"3"), (b"b", b"2")
+    ]
+    assert ss.resolve_selector(KeySelector.first_greater_than(b"a"), 20) == b"b"
+    assert ss.resolve_selector(KeySelector.last_less_than(b"c"), 20) == b"b"
+
+
+def test_storage_recovery_from_engine_plus_log(tmp_path):
+    eng_path = str(tmp_path / "e")
+    wal_path = str(tmp_path / "w")
+    engine = KeyValueStoreMemory(eng_path)
+    tlog = TLog(wal_path=wal_path)
+    ss = StorageServer(engine=engine)
+    ss.apply(10, [_set(b"a", b"1")])
+    tlog.push(10, [_set(b"a", b"1")])
+    ss.flush(10)  # durable
+    ss.apply(20, [_set(b"b", b"2")])
+    tlog.push(20, [_set(b"b", b"2")])  # in WAL, not yet durable in engine
+    engine.close()
+    tlog.close()
+
+    # crash + restart: engine at version 10, WAL has everything
+    engine2 = KeyValueStoreMemory(eng_path)
+    records = TLog.recover(wal_path)
+    ss2 = StorageServer.recover(engine2, records)
+    assert ss2.durable_version == 10
+    assert ss2.version == 20
+    assert ss2.get(b"a", 20) == b"1"
+    assert ss2.get(b"b", 20) == b"2"
+
+
+def test_cluster_restart_end_to_end(tmp_path):
+    """Full-cluster crash/restart: engine snapshot + WAL replay, version
+    authority resumes above everything recovered, old reads fenced."""
+    from foundationdb_tpu.server.cluster import Cluster
+
+    wal = str(tmp_path / "wal")
+    eng_path = str(tmp_path / "store")
+    c1 = Cluster(
+        wal_path=wal,
+        storage_engines=[KeyValueStoreMemory(eng_path)],
+        resolver_backend="cpu",
+    )
+    db1 = c1.database()
+    db1[b"a"] = b"1"
+    c1.storage.flush()  # make durable, then write more (WAL-only)
+    db1[b"b"] = b"2"
+    pre_crash_version = c1.sequencer.committed_version
+    tr_old = db1.create_transaction()
+    tr_old.get_read_version()  # in-flight across the "crash"
+    c1.storage.engine.close()
+    c1.tlog.close()
+
+    c2 = Cluster(
+        wal_path=wal,
+        storage_engines=[KeyValueStoreMemory(eng_path)],
+        resolver_backend="cpu",
+    )
+    db2 = c2.database()
+    assert c2.sequencer.committed_version >= pre_crash_version
+    assert db2[b"a"] == b"1"
+    assert db2[b"b"] == b"2"
+    db2[b"c"] = b"3"  # writes resume with monotone versions
+    assert db2[b"c"] == b"3"
+    # a transaction from the old incarnation is fenced by the new window
+    tr = db2.create_transaction()
+    tr.set_read_version(pre_crash_version - 1)
+    tr.set(b"x", b"y")
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1007  # transaction_too_old
+
+
+def test_storage_differential_vs_dict_oracle():
+    """Randomized sets/clears/flushes vs a plain dict, reads at latest."""
+    rng = random.Random(5)
+    ss = StorageServer()
+    oracle = {}
+    v = 0
+    keys = [b"k%02d" % i for i in range(30)]
+    for _ in range(300):
+        v += 1
+        op = rng.random()
+        if op < 0.5:
+            k = rng.choice(keys)
+            val = b"v%d" % rng.randrange(1000)
+            ss.apply(v, [_set(k, val)])
+            oracle[k] = val
+        elif op < 0.7:
+            b, e = sorted(rng.sample(keys, 2))
+            ss.apply(v, [_clr(b, e)])
+            for k in list(oracle):
+                if b <= k < e:
+                    del oracle[k]
+        elif op < 0.85:
+            ss.apply(v, [])
+        else:
+            ss.apply(v, [])
+            ss.flush(v - rng.randrange(0, 3))
+        got = dict(ss.get_range(b"", b"\xff", ss.version))
+        assert got == oracle, f"divergence at version {v}"
