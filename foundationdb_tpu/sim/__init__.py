@@ -1,0 +1,2 @@
+from foundationdb_tpu.sim.buggify import BUGGIFY, Buggify  # noqa: F401
+from foundationdb_tpu.sim.simulation import Simulation  # noqa: F401

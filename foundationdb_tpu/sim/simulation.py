@@ -1,0 +1,160 @@
+"""Deterministic whole-cluster simulation with fault injection.
+
+Ref parity: fdbrpc/sim2.actor.cpp + fdbserver/SimulatedCluster — the
+whole cluster runs in one process under a seeded scheduler; workloads are
+cooperative actors interleaved at yield points; BUGGIFY sites inject
+faults (spurious commit_unknown_result, dropped batches, GRV rejections,
+full crash/recovery); invariants are checked at the end. The same seed
+replays the same history, so failures are debuggable.
+
+Workload actors are generators: each ``yield`` is a scheduling point.
+Real concurrency hazards (OCC conflicts, retry loops, fencing across
+recovery) arise from the interleaving, exactly like the reference's
+actor model — cooperative single-thread, adversarial schedule.
+"""
+
+import os
+import random
+import tempfile
+
+from foundationdb_tpu.core.errors import FDBError, err
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
+from foundationdb_tpu.sim.buggify import Buggify
+
+
+class FaultyCommitProxy:
+    """Wraps the real commit proxy with BUGGIFY faults at the RPC edge
+    (ref: sim2's FlowTransport-level fault injection).
+
+    Injected faults and what they model:
+      - commit_applied_then_unknown: reply lost after durability →
+        commit_unknown_result with the batch actually committed.
+      - commit_dropped: request lost before resolution → the batch is
+        NOT committed; clients see commit_unknown_result.
+    Both are legal outcomes of 1021 — clients must handle either.
+    """
+
+    def __init__(self, inner, buggify, rng):
+        self._inner = inner
+        self._buggify = buggify
+        self._rng = rng
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def commit(self, request):
+        if self._buggify("commit_dropped"):
+            return err("commit_unknown_result")
+        result = self._inner.commit(request)
+        if not isinstance(result, FDBError) and self._buggify("commit_applied_then_unknown"):
+            return err("commit_unknown_result")
+        return result
+
+
+class FaultyGrvProxy:
+    def __init__(self, inner, buggify):
+        self._inner = inner
+        self._buggify = buggify
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def get_read_version(self, priority="default"):
+        if self._buggify("grv_rejected"):
+            raise err("process_behind")
+        return self._inner.get_read_version(priority)
+
+
+class Simulation:
+    def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
+                 datadir=None, **cluster_kwargs):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.buggify = Buggify(seed=seed, enabled=buggify)
+        self.crash_p = crash_p
+        self.n_resolvers = n_resolvers
+        self.cluster_kwargs = dict(cluster_kwargs)
+        self.cluster_kwargs.setdefault("resolver_backend", "cpu")
+        self.datadir = datadir or tempfile.mkdtemp(prefix="fdbtpu-sim-")
+        os.makedirs(self.datadir, exist_ok=True)
+        self.recoveries = 0
+        self.steps = 0
+        self.schedule_hash = 0  # order-sensitive digest of scheduling choices
+        self._actors = []  # (name, generator)
+        self._build_cluster()
+        self.db = self.cluster.database()
+
+    # ───────────────────────── cluster lifecycle ──────────────────────────
+    @property
+    def _wal_path(self):
+        return os.path.join(self.datadir, "wal")
+
+    @property
+    def _store_path(self):
+        return os.path.join(self.datadir, "store")
+
+    def _build_cluster(self):
+        self.cluster = Cluster(
+            wal_path=self._wal_path,
+            storage_engines=[KeyValueStoreMemory(self._store_path)],
+            n_resolvers=self.n_resolvers,
+            **self.cluster_kwargs,
+        )
+        self.cluster.commit_proxy = FaultyCommitProxy(
+            self.cluster.commit_proxy, self.buggify, self.rng
+        )
+        self.cluster.grv_proxy = FaultyGrvProxy(self.cluster.grv_proxy, self.buggify)
+
+    def crash_and_recover(self):
+        """Kill the cluster (losing all volatile state) and restart from
+        the engine snapshot + WAL. In-flight transactions keep their old
+        read versions and get fenced by the recovered resolver window."""
+        self.cluster.storage.engine.close()
+        self.cluster.tlog.close()
+        old_db = self.db
+        self._build_cluster()
+        # the Database handle survives; transactions resolve the cluster
+        # through it, so in-flight txns now talk to the new incarnation
+        old_db._cluster = self.cluster
+        self.db = old_db
+        self.recoveries += 1
+
+    # ─────────────────────────── scheduling ───────────────────────────────
+    def add_workload(self, name, gen):
+        """gen: a generator object; each ``yield`` is a scheduling point."""
+        self._actors.append((name, gen))
+
+    def run(self, max_steps=1_000_000):
+        """Interleave all actors to completion under the seeded schedule."""
+        live = list(self._actors)
+        while live:
+            self.steps += 1
+            if self.steps > max_steps:
+                raise RuntimeError(f"simulation exceeded {max_steps} steps")
+            if self.crash_p and self.buggify("cluster_crash", fire_p=self.crash_p):
+                self.crash_and_recover()
+            i = self.rng.randrange(len(live))
+            self.schedule_hash = (self.schedule_hash * 1000003 + i) & (2**64 - 1)
+            name, gen = live[i]
+            try:
+                next(gen)
+            except StopIteration:
+                live.pop(i)
+        self._actors = []
+
+    def quiesce(self):
+        """Flush storage so everything is durable (end-of-run barrier)."""
+        self.cluster.storage.flush()
+
+    def close(self):
+        """Close WAL/engine handles (the datadir itself is left for
+        inspection; callers own its lifetime)."""
+        self.cluster.storage.engine.close()
+        self.cluster.tlog.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

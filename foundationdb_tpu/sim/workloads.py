@@ -1,0 +1,227 @@
+"""Simulation workloads + invariant checks.
+
+Ref parity: fdbserver/workloads/ — Cycle.actor.cpp (ring-pointer swaps,
+cycle invariant), the ApiCorrectness/Serializability family (randomized
+ops vs an oracle), AtomicOps.actor.cpp (counter sums). Each workload is a
+generator; every ``yield`` is a scheduling point where the simulation may
+interleave other actors or inject faults.
+"""
+
+import struct
+import zlib
+
+from foundationdb_tpu.core.errors import FDBError
+
+
+def run_txn(db, fn):
+    """Cooperative transactional runner (generator).
+
+    Yields once per attempt; returns (outcome, result, tr) where outcome
+    is "committed" or "unknown" (commit_unknown_result) — the caller owns
+    1021 disambiguation, like a client.
+    """
+    tr = db.create_transaction()
+    while True:
+        yield
+        try:
+            result = fn(tr)
+            tr.commit()
+            return ("committed", result, tr)
+        except FDBError as e:
+            if e.code == 1021:
+                return ("unknown", None, tr)
+            if not e.is_retryable:
+                raise
+            tr.reset()
+
+
+def _enc(i):
+    return struct.pack(">I", i)
+
+
+def _dec(b):
+    return struct.unpack(">I", b)[0]
+
+
+# ───────────────────────────── cycle ────────────────────────────────────
+def cycle_setup(db, n_nodes, prefix=b"cycle/"):
+    def fn(tr):
+        for i in range(n_nodes):
+            tr.set(prefix + _enc(i), _enc((i + 1) % n_nodes))
+
+    db.run(fn)
+
+
+def cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
+    """Pointer-rotation transactions: read r→a→b→c, relink to r→b→a→c.
+    Every committed (or half-committed — there are none, commits are
+    atomic) state is a single n-cycle, so the invariant survives
+    commit_unknown_result without idempotency tricks — exactly why the
+    reference uses this shape under fault injection."""
+    key = lambda i: prefix + _enc(i)
+    for _ in range(n_ops):
+        r = rng.randrange(n_nodes)
+
+        def fn(tr, r=r):
+            a = _dec(tr.get(key(r)))
+            b = _dec(tr.get(key(a)))
+            c = _dec(tr.get(key(b)))
+            tr.set(key(r), _enc(b))
+            tr.set(key(a), _enc(c))
+            tr.set(key(b), _enc(a))
+
+        yield from run_txn(db, fn)
+
+
+def slow_cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
+    """Cycle txns with yields *between* reads and commit: read versions
+    go stale across interleavings and crashes, exercising OCC conflicts
+    and recovery fencing on the same invariant."""
+    key = lambda i: prefix + _enc(i)
+    ops = 0
+    while ops < n_ops:
+        tr = db.create_transaction()
+        try:
+            yield
+            r = rng.randrange(n_nodes)
+            a = _dec(tr.get(key(r)))
+            yield
+            b = _dec(tr.get(key(a)))
+            yield
+            c = _dec(tr.get(key(b)))
+            tr.set(key(r), _enc(b))
+            tr.set(key(a), _enc(c))
+            tr.set(key(b), _enc(a))
+            yield
+            tr.commit()
+            ops += 1
+        except FDBError as e:
+            if e.code == 1021:
+                ops += 1  # either way the cycle invariant holds
+            elif not e.is_retryable:
+                raise
+            # retryable: abandon the attempt, new transaction
+
+
+def cycle_check(db, n_nodes, prefix=b"cycle/"):
+    """The walk from node 0 must traverse all nodes and close."""
+    rows = dict(db.get_range(prefix, prefix + b"\xff"))
+    assert len(rows) == n_nodes, f"expected {n_nodes} nodes, got {len(rows)}"
+    seen = set()
+    cur = 0
+    for _ in range(n_nodes):
+        assert cur not in seen, f"cycle broken: revisited {cur}"
+        seen.add(cur)
+        cur = _dec(rows[prefix + _enc(cur)])
+    assert cur == 0, f"walk did not close: ended at {cur}"
+    assert len(seen) == n_nodes
+
+
+# ──────────────────────── serializability ───────────────────────────────
+class SerializabilityLog:
+    """Shared committed-transaction log for the final linearization check."""
+
+    def __init__(self):
+        self.entries = []  # (stamp: 10B versionstamp, reads|None, writes)
+
+
+def serializability_workload(db, log, actor_id, n_txns, n_keys, rng,
+                             prefix=b"ser/"):
+    """Random read-modify-write txns, logged with their exact commit
+    versionstamp for the end-of-run serial replay.
+
+    Each txn sets a per-actor receipt via SET_VERSIONSTAMPED_VALUE. On
+    commit_unknown_result the actor disambiguates by reading its own
+    receipt (only it ever writes that key) — and because the receipt
+    carries the commit versionstamp, even an ambiguous commit is logged
+    at its true position in the serial order. The data write value is a
+    function of the token alone so it is reconstructable post-hoc.
+    """
+    key = lambda i: prefix + b"k%03d" % i
+    receipt_key = prefix + b"receipt/%d" % actor_id
+    for t in range(n_txns):
+        token = b"%d:%d:" % (actor_id, t)
+        ks = rng.sample(range(n_keys), 3)
+        wval = _enc(zlib.crc32(token))
+
+        def fn(tr, ks=ks, token=token, wval=wval):
+            reads = {key(k): tr.get(key(k)) for k in ks}
+            tr.set(key(ks[0]), wval)
+            # value = token + 10-byte stamp placeholder + LE32 offset trailer
+            tr.set_versionstamped_value(
+                receipt_key,
+                token + b"\x00" * 10 + struct.pack("<I", len(token)),
+            )
+            return reads
+
+        outcome, reads, tr = yield from run_txn(db, fn)
+        writes = {key(ks[0]): wval}
+        if outcome == "committed":
+            stamp = tr.get_versionstamp()()
+            w = dict(writes)
+            w[receipt_key] = token + stamp
+            log.entries.append((stamp, reads, w))
+        else:
+            check = yield from run_txn(db, lambda tr: tr.get(receipt_key))
+            val = check[1]
+            if check[0] == "unknown" or val is None or not val.startswith(token):
+                continue  # did not commit (or unknowable)
+            stamp = val[len(token):len(token) + 10]
+            # committed: the reads were lost with the reply, but the stamp
+            # places the writes exactly in the serial order
+            w = dict(writes)
+            w[receipt_key] = val
+            log.entries.append((stamp, None, w))
+
+
+def serializability_check(db, log, n_keys, prefix=b"ser/"):
+    """Replay the committed log in commit-versionstamp order against an
+    oracle: every recorded read and the final database state must match —
+    strict serializability of the OCC pipeline, checked end to end."""
+    key = lambda i: prefix + b"k%03d" % i
+    oracle = {}
+    for stamp, reads, writes in sorted(log.entries, key=lambda e: e[0]):
+        if reads is not None:
+            for k, v in reads.items():
+                assert oracle.get(k) == v, (
+                    f"read {k!r}={v!r} inconsistent with serial replay "
+                    f"{oracle.get(k)!r}"
+                )
+        for k, v in writes.items():
+            oracle[k] = v
+    final = dict(db.get_range(prefix, prefix + b"\xff"))
+    for k, v in oracle.items():
+        assert final.get(k) == v, f"final state diverges at {k!r}"
+    for k in [key(i) for i in range(n_keys)]:
+        assert final.get(k) == oracle.get(k), f"final state diverges at {k!r}"
+
+
+# ───────────────────────────── atomic ops ───────────────────────────────
+def atomic_counter_workload(db, actor_id, n_ops, rng, totals,
+                            prefix=b"ctr/"):
+    """Atomic ADDs with 1021 disambiguation via a receipt; ``totals``
+    accrues the definitely-applied sum per counter for the final check."""
+    receipt_key = prefix + b"receipt/%d" % actor_id
+    for t in range(n_ops):
+        c = rng.randrange(4)
+        delta = rng.randrange(1, 10)
+        token = b"%d:%d" % (actor_id, t)
+        ckey = prefix + b"c%d" % c
+
+        def fn(tr, ckey=ckey, delta=delta, token=token):
+            tr.add(ckey, struct.pack("<q", delta))
+            tr.set(receipt_key, token)
+
+        outcome, _, _tr = yield from run_txn(db, fn)
+        if outcome == "unknown":
+            check = yield from run_txn(db, lambda tr: tr.get(receipt_key))
+            if check[0] == "unknown" or check[1] != token:
+                continue
+        totals[c] = totals.get(c, 0) + delta
+
+
+def atomic_counter_check(db, totals, prefix=b"ctr/"):
+    for c, expect in totals.items():
+        raw = db.get(prefix + b"c%d" % c)
+        got = struct.unpack("<q", raw)[0] if raw else 0
+        assert got == expect, f"counter {c}: {got} != {expect}"

@@ -23,7 +23,12 @@ def retry_loop(tr, fn):
 class Database:
     def __init__(self, cluster):
         self._cluster = cluster
-        self._knobs = cluster.knobs
+
+    @property
+    def _knobs(self):
+        # resolved per use so a swapped cluster (simulated recovery) never
+        # leaves transactions running with the dead incarnation's knobs
+        return self._cluster.knobs
 
     def create_transaction(self):
         return Transaction(self)

@@ -99,8 +99,15 @@ class _Snapshot:
 class Transaction:
     def __init__(self, database):
         self.db = database
-        self._cluster = database._cluster
         self._reset()
+
+    @property
+    def _cluster(self):
+        # resolved through the Database each use: after a simulated crash
+        # swaps the cluster, in-flight transactions talk to the *new*
+        # incarnation and get fenced (too_old) instead of silently
+        # committing into a dead object graph
+        return self.db._cluster
 
     def _reset(self):
         self._read_version = None
