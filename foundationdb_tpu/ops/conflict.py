@@ -239,42 +239,50 @@ def resolve_batch(
 
     hist = jnp.zeros((T,), bool)
 
+    # The ring + coarse interval summaries are populated ONLY by range
+    # writes: with params.range_writes == 0 they are statically all-zero,
+    # and checking them would stream [T, *, KR, W] broadcast intermediates
+    # through HBM for nothing (this alone is ~25x on the YCSB-A point
+    # workload). Gate every dead lane on the static params.
+    if params.range_writes:
+        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
+        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
+
     # point reads vs point-write hash table (exact lane)
     if params.point_reads:
         own_pr = hash_owned(batch.pr_hash)
         ht_v = state.ht[batch.pr_hash & u32((1 << params.hash_bits) - 1)]  # [T, PR]
         hit = (ht_v > rv[:, None]) & batch.pr_mask & own_pr
-        # point reads vs recent range-writes (exact ring)
-        in_rng = _point_in(
-            batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
-        )  # [T, PR, KR]
-        newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
-        hit |= jnp.any(in_rng & newer, axis=2) & batch.pr_mask
-        # point reads vs evicted range-writes (coarse interval summary)
-        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
-        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
-        coarse = jnp.minimum(pref_L[batch.pr_bucket], suf_R[batch.pr_bucket])
-        hit |= (coarse > rv[:, None]) & batch.pr_mask
+        if params.range_writes:
+            # point reads vs recent range-writes (exact ring)
+            in_rng = _point_in(
+                batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
+            )  # [T, PR, KR]
+            newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+            hit |= jnp.any(in_rng & newer, axis=2) & batch.pr_mask
+            # point reads vs evicted range-writes (coarse interval summary)
+            coarse = jnp.minimum(pref_L[batch.pr_bucket], suf_R[batch.pr_bucket])
+            hit |= (coarse > rv[:, None]) & batch.pr_mask
         hist |= jnp.any(hit, axis=1)
-    else:
-        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
-        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
 
     # range reads vs ring (exact), coarse ranges, and coarse points
     if params.range_reads:
-        ov = ranges_overlap(
-            batch.rr_b[:, :, None, :],
-            batch.rr_e[:, :, None, :],
-            state.ring_b[None, None],
-            state.ring_e[None, None],
-        )  # [T, RR, KR]
-        newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
-        hit = jnp.any(ov & newer, axis=2) & batch.rr_mask
-        coarse_rng = jnp.minimum(pref_L[batch.rr_hi], suf_R[batch.rr_lo])
-        hit |= (coarse_rng > rv[:, None]) & batch.rr_mask
-        levels = _sparse_table(state.point_coarse)
-        pmax = _range_max(levels, batch.rr_lo, batch.rr_hi)
-        hit |= (pmax > rv[:, None]) & batch.rr_mask
+        hit = jnp.zeros((T, params.range_reads), bool)
+        if params.range_writes:
+            ov = ranges_overlap(
+                batch.rr_b[:, :, None, :],
+                batch.rr_e[:, :, None, :],
+                state.ring_b[None, None],
+                state.ring_e[None, None],
+            )  # [T, RR, KR]
+            newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+            hit |= jnp.any(ov & newer, axis=2) & batch.rr_mask
+            coarse_rng = jnp.minimum(pref_L[batch.rr_hi], suf_R[batch.rr_lo])
+            hit |= (coarse_rng > rv[:, None]) & batch.rr_mask
+        if params.point_writes:
+            levels = _sparse_table(state.point_coarse)
+            pmax = _range_max(levels, batch.rr_lo, batch.rr_hi)
+            hit |= (pmax > rv[:, None]) & batch.rr_mask
         hist |= jnp.any(hit, axis=1)
 
     hist = por(hist)
@@ -360,8 +368,11 @@ def resolve_batch(
         ht = ht.at[flat_h].max(
             jnp.where(ht_ok, cv, u32(0)), mode="promise_in_bounds"
         )
-        val = jnp.where(ok.reshape(-1), cv, u32(0))
-        point_coarse = point_coarse.at[jnp.clip(flat_bk, 0, point_coarse.shape[0] - 1)].max(val)
+        if params.range_reads:  # point_coarse is read only by range reads
+            val = jnp.where(ok.reshape(-1), cv, u32(0))
+            point_coarse = point_coarse.at[
+                jnp.clip(flat_bk, 0, point_coarse.shape[0] - 1)
+            ].max(val)
 
     ring_b, ring_e, ring_v = state.ring_b, state.ring_e, state.ring_v
     ring_lo, ring_hi, ring_mask = state.ring_lo, state.ring_hi, state.ring_mask

@@ -10,20 +10,25 @@ process for deterministic testing.
 """
 
 import dataclasses
+import itertools
 
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.resolver.resolver import Resolver
+from foundationdb_tpu.server.coordination import CoordinationQuorum
+from foundationdb_tpu.server.datadistribution import DataDistributor
 from foundationdb_tpu.server.grv import GrvProxy
 from foundationdb_tpu.server.proxy import CommitProxy
 from foundationdb_tpu.server.ratekeeper import Ratekeeper
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.utils.trace import TraceEvent
 
 
 class Cluster:
     def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
                  version_clock="counter", storage_engines=None,
+                 coordination=None, n_coordinators=3, coordination_dir=None,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -62,6 +67,20 @@ class Cluster:
                 if version > s.version:
                     s.apply(version, mutations)
         recovered = max((s.version for s in self.storages), default=0)
+
+        # ── coordinated cluster state (ref: master recovery reading then
+        # locking the coordinators' generation before recruiting roles) ──
+        self.coordination = coordination or CoordinationQuorum.local(
+            n_coordinators, coordination_dir
+        )
+        prior = self.coordination.read_quorum() or {}
+        self.generation = prior.get("generation", 0) + 1
+        self.coordination.write_quorum(
+            {"generation": self.generation, "recovered_version": recovered}
+        )
+        TraceEvent("MasterRecovered").detail(
+            generation=self.generation, version=recovered).log()
+
         self.tlog = TLog(wal_path=wal_path)
         self.tlog._first_version = recovered
         self.sequencer = Sequencer(
@@ -70,16 +89,32 @@ class Cluster:
         self.resolvers = [
             Resolver(knobs, base_version=recovered) for _ in range(n_resolvers)
         ]
+        # v1 placement is full replication (every storage holds the whole
+        # keyspace); DD still accounts shard sizes + boundaries so splits
+        # and status are live, and partitioned placement can land on top.
+        self.dd = DataDistributor(self.storages, replication=n_storage)
+        self._read_rr = itertools.count()  # round-robin read balancing
         self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
         self.commit_proxy = CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
-            knobs, self.ratekeeper,
+            knobs, self.ratekeeper, dd=self.dd,
         )
 
     # v1: single storage team holding the whole keyspace; reads go to [0].
     @property
     def storage(self):
         return self.storages[0]
+
+    def read_storage(self, key=b""):
+        """Replica choice for a read (ref: fdbrpc/LoadBalance.actor.h —
+        the client spreads reads over the shard's team). The shard map
+        names the team; round-robin spreads load across its members."""
+        team = self.dd.map.team_for(key)
+        return self.storages[team[next(self._read_rr) % len(team)]]
+
+    def rebalance(self):
+        """One data-distribution round (splits/merges/moves)."""
+        return self.dd.rebalance()
 
     def database(self):
         from foundationdb_tpu.txn.database import Database
@@ -90,7 +125,12 @@ class Cluster:
         """Cluster status summary (ref: fdbcli status json, StatusWorker)."""
         return {
             "cluster": {
-                "generation": 1,
+                "generation": self.generation,
+                "coordinators": len(self.coordination.coordinators),
+                "data": {
+                    "shards": len(self.dd.map),
+                    "team_bytes": self.dd.team_bytes(),
+                },
                 "database_available": True,
                 "workload": {
                     "transactions": {

@@ -1,0 +1,206 @@
+"""Data distribution: shard map, splits/merges, and team rebalancing.
+
+Ref parity: fdbserver/DataDistribution.actor.cpp + DDTracker/DDQueue —
+the reference divides the keyspace into contiguous shards, tracks each
+shard's size via storage-server byte samples, splits shards that grow
+past the split threshold, merges runs of small shards, and enqueues
+RelocateShard moves so every storage team carries a fair share.
+
+Ours is the same control loop, host-side (this is metadata work — it
+does not belong on the TPU): a ``ShardMap`` of boundary → team, byte
+accounting fed by the commit proxy, and a ``rebalance()`` step the
+cluster pumps periodically (simulation pumps it deterministically).
+Replication: a shard's team is a list of storage ids; moves copy the
+shard's data to the destination before flipping the map, so reads at
+old versions keep working (the reference's fetchKeys + TSS-free path).
+"""
+
+import bisect
+
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+class ShardMap:
+    """Contiguous partition of the keyspace: boundaries[i] owns
+    [boundaries[i], boundaries[i+1]). boundaries[0] is always b"".
+
+    Ref: keyServers / shardBoundaries in the system keyspace.
+    """
+
+    def __init__(self, teams=None):
+        self.boundaries = [b""]
+        self.teams = [list(teams[0]) if teams else [0]]
+
+    def team_for(self, key):
+        return self.teams[bisect.bisect_right(self.boundaries, key) - 1]
+
+    def shard_index(self, key):
+        return bisect.bisect_right(self.boundaries, key) - 1
+
+    def shard_range(self, i):
+        end = self.boundaries[i + 1] if i + 1 < len(self.boundaries) else None
+        return self.boundaries[i], end
+
+    def shards_overlapping(self, begin, end):
+        """Indices of shards intersecting [begin, end)."""
+        i = self.shard_index(begin)
+        out = []
+        while i < len(self.boundaries):
+            b = self.boundaries[i]
+            if end is not None and b >= end:
+                break
+            out.append(i)
+            i += 1
+        return out
+
+    def split(self, i, at):
+        b, e = self.shard_range(i)
+        if not (b < at and (e is None or at < e)):
+            raise ValueError(f"split point {at!r} outside shard [{b!r}, {e!r})")
+        self.boundaries.insert(i + 1, at)
+        self.teams.insert(i + 1, list(self.teams[i]))
+
+    def merge(self, i):
+        """Merge shard i+1 into shard i (teams must match)."""
+        if i + 1 >= len(self.boundaries):
+            raise ValueError("no right neighbor to merge")
+        if self.teams[i] != self.teams[i + 1]:
+            raise ValueError("cannot merge shards on different teams")
+        del self.boundaries[i + 1]
+        del self.teams[i + 1]
+
+    def assign(self, i, team):
+        self.teams[i] = list(team)
+
+    def __len__(self):
+        return len(self.boundaries)
+
+
+class DataDistributor:
+    """The DD control loop over a cluster's storage servers.
+
+    The commit proxy calls ``note_write(key, nbytes)`` per mutation
+    (the analog of storage byte sampling); ``rebalance()`` runs one
+    round of split / merge / move decisions and returns the moves it
+    performed, each as (shard_range, old_team, new_team).
+    """
+
+    def __init__(self, storages, shard_map=None, replication=1,
+                 max_shard_bytes=250_000, min_shard_bytes=10_000):
+        self.storages = storages
+        self.replication = min(replication, len(storages))
+        self.map = shard_map or ShardMap(
+            teams=[list(range(self.replication))]
+        )
+        self.max_shard_bytes = max_shard_bytes
+        self.min_shard_bytes = min_shard_bytes
+        self._sizes = [0] * len(self.map)
+        # per-shard hottest-prefix sample for split points
+        self._last_key = [None] * len(self.map)
+
+    def note_write(self, key, nbytes):
+        i = self.map.shard_index(key)
+        self._sizes[i] += nbytes
+        self._last_key[i] = key
+
+    def note_clear_range(self, begin, end):
+        for i in self.map.shards_overlapping(begin, end):
+            self._sizes[i] = max(0, self._sizes[i] // 2)
+
+    def team_bytes(self):
+        out = [0] * len(self.storages)
+        for size, team in zip(self._sizes, self.map.teams):
+            for s in team:
+                out[s] += size
+        return out
+
+    def rebalance(self):
+        moves = []
+        self._split_large()
+        self._merge_small()
+        moves.extend(self._move_for_balance())
+        return moves
+
+    # ── splits (ref: shardSplitter) ──
+    def _split_large(self):
+        i = 0
+        while i < len(self.map):
+            if self._sizes[i] > self.max_shard_bytes:
+                at = self._split_point(i)
+                if at is not None:
+                    self.map.split(i, at)
+                    half = self._sizes[i] // 2
+                    self._sizes[i] -= half
+                    self._sizes.insert(i + 1, half)
+                    self._last_key.insert(i + 1, self._last_key[i])
+                    TraceEvent("DDShardSplit").detail(
+                        index=i, at=at, bytes=half * 2).log()
+                    i += 1
+            i += 1
+
+    def _split_point(self, i):
+        """Median key of the shard from the owning storage's live data."""
+        b, e = self.map.shard_range(i)
+        team = self.map.teams[i]
+        storage = self.storages[team[0]]
+        keys = [k for k, _ in storage.read_range(
+            b, e, storage.version, limit=1001)]
+        if len(keys) < 2:
+            return None
+        at = keys[len(keys) // 2]
+        return at if b < at else None
+
+    # ── merges (ref: shardMerger) ──
+    def _merge_small(self):
+        i = 0
+        while i + 1 < len(self.map):
+            if (
+                self._sizes[i] + self._sizes[i + 1] < self.min_shard_bytes
+                and self.map.teams[i] == self.map.teams[i + 1]
+            ):
+                self.map.merge(i)
+                self._sizes[i] += self._sizes.pop(i + 1)
+                self._last_key.pop(i + 1)
+            else:
+                i += 1
+
+    # ── moves (ref: BgDDMountainChopper / ValleyFiller) ──
+    def _move_for_balance(self):
+        if len(self.storages) < 2:
+            return []
+        moves = []
+        for _ in range(2):  # bounded moves per round, like DD's queue
+            load = self.team_bytes()
+            hot = max(range(len(load)), key=load.__getitem__)
+            cold = min(range(len(load)), key=load.__getitem__)
+            diff = load[hot] - load[cold]
+            if diff < self.max_shard_bytes:
+                break
+            # biggest shard on `hot` but not `cold` that strictly improves
+            # balance (size < diff, else the move just flips the skew)
+            cands = [
+                i for i, team in enumerate(self.map.teams)
+                if hot in team and cold not in team and self._sizes[i] < diff
+            ]
+            if not cands:
+                break
+            i = max(cands, key=self._sizes.__getitem__)
+            old_team = list(self.map.teams[i])
+            new_team = [cold if s == hot else s for s in old_team]
+            self._relocate(i, old_team, new_team)
+            moves.append((self.map.shard_range(i), old_team, new_team))
+        return moves
+
+    def _relocate(self, i, old_team, new_team):
+        """Copy shard data to joining storages, then flip the map entry
+        (ref: fetchKeys then the keyServers commit)."""
+        b, e = self.map.shard_range(i)
+        src = self.storages[old_team[0]]
+        joining = [s for s in new_team if s not in old_team]
+        for sid in joining:
+            dst = self.storages[sid]
+            rows = src.read_range(b, e, src.version, limit=None)
+            dst.ingest_shard(b, e, src.version, rows)
+        self.map.assign(i, new_team)
+        TraceEvent("DDRelocateShard").detail(
+            begin=b, end=e, old=old_team, new=new_team).log()

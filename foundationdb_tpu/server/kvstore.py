@@ -183,10 +183,13 @@ class KeyValueStoreSQLite:
         ]
 
     def iter_range(self, begin, end, reverse=False):
-        q = "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k"
-        if reverse:
-            q += " DESC"
-        for k, v in self._conn.execute(q, (begin, end)):  # lazy cursor
+        q = "SELECT k, v FROM kv WHERE k >= ?"
+        args = [begin]
+        if end is not None:
+            q += " AND k < ?"
+            args.append(end)
+        q += " ORDER BY k DESC" if reverse else " ORDER BY k"
+        for k, v in self._conn.execute(q, args):  # lazy cursor
             yield bytes(k), bytes(v)
 
     def stored_version(self):

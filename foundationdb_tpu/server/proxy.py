@@ -30,13 +30,15 @@ class CommitRequest:
 
 
 class CommitProxy:
-    def __init__(self, sequencer, resolvers, tlog, storages, knobs, ratekeeper=None):
+    def __init__(self, sequencer, resolvers, tlog, storages, knobs,
+                 ratekeeper=None, dd=None):
         self.sequencer = sequencer
         self.resolvers = resolvers  # list; key-range sharded when >1
         self.tlog = tlog
         self.storages = storages
         self.knobs = knobs
         self.ratekeeper = ratekeeper
+        self.dd = dd  # data distribution byte accounting
         self.commit_count = 0
         self.conflict_count = 0
 
@@ -88,6 +90,15 @@ class CommitProxy:
                 batch_conflicts += 1
         self.conflict_count += batch_conflicts
         self.commit_count += sum(1 for r in results if not isinstance(r, FDBError))
+
+        if self.dd is not None:
+            for m in batch_mutations:
+                if m.op == Op.CLEAR_RANGE:
+                    self.dd.note_clear_range(m.key, m.param)
+                else:
+                    self.dd.note_write(
+                        m.key, len(m.key) + len(m.param or b"")
+                    )
 
         # push even empty batches so storage's version advances with cv
         self.tlog.push(cv, batch_mutations)

@@ -225,6 +225,29 @@ class StorageServer:
         for k, _ in self._iter_live(begin, end, version, reverse=reverse):
             yield k
 
+    def read_range(self, begin, end, version, limit=None):
+        """Plain (key, value) list over [begin, end) at ``version`` —
+        the shard-copy read used by data distribution (ref: fetchKeys'
+        getRange stream), bypassing key-selector resolution."""
+        self._check_version(version)
+        out = []
+        for kv in self._iter_live(begin, end, version):
+            out.append(kv)
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def ingest_shard(self, begin, end, version, rows):
+        """Bulk-load a shard copied from another storage at ``version``
+        (ref: fetchKeys applying fetched blocks). Clears [begin, end)
+        first so deletes on the source do not survive on the joiner."""
+        if version > self.version:
+            # adopt the source's version for this server's frontier
+            self.version = version
+        self._apply_clear_range(begin, end, version)
+        for k, v in rows:
+            self._append(k, version, v)
+
     def resolve_selector(self, sel: KeySelector, version):
         """Resolve a key selector to a concrete key (ref: storageserver
         findKey): start at the last live key < (or <=) sel.key, then move

@@ -35,10 +35,9 @@ class FaultyCommitProxy:
     Both are legal outcomes of 1021 — clients must handle either.
     """
 
-    def __init__(self, inner, buggify, rng):
+    def __init__(self, inner, buggify):
         self._inner = inner
         self._buggify = buggify
-        self._rng = rng
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -95,14 +94,22 @@ class Simulation:
         return os.path.join(self.datadir, "store")
 
     def _build_cluster(self):
+        # deterministic traces: events are stamped with the step counter,
+        # not wall time, so a seed replays byte-identical trace output
+        from foundationdb_tpu.utils.trace import global_trace_log
+
+        global_trace_log().clock = lambda: self.steps
         self.cluster = Cluster(
             wal_path=self._wal_path,
             storage_engines=[KeyValueStoreMemory(self._store_path)],
             n_resolvers=self.n_resolvers,
+            # coordinators persist beside the WAL so crash_and_recover
+            # exercises the real quorum-locking recovery path
+            coordination_dir=self.datadir,
             **self.cluster_kwargs,
         )
         self.cluster.commit_proxy = FaultyCommitProxy(
-            self.cluster.commit_proxy, self.buggify, self.rng
+            self.cluster.commit_proxy, self.buggify
         )
         self.cluster.grv_proxy = FaultyGrvProxy(self.cluster.grv_proxy, self.buggify)
 

@@ -1,0 +1,277 @@
+r"""fdbcli — the interactive/scripted cluster shell.
+
+Ref parity: fdbcli/fdbcli.actor.cpp. Same command set where it makes
+sense in-process: get/set/clear/clearrange/getrange/getrangekeys,
+begin/commit/reset (explicit transaction mode), writemode, status
+[json], getversion, advanceversion, option, tenant
+create/list/delete/get, kill/suspend analogs are out of scope (no
+process model yet). Key literals use fdbcli's escaping: printable
+bytes plus \xNN, \\, quoted strings.
+
+Usage::
+
+    from foundationdb_tpu.tools.cli import Cli
+    Cli(db).run_command('set hello world')
+    Cli(db).repl()              # interactive
+
+or ``python -m foundationdb_tpu.tools.cli --exec "status json"``.
+"""
+
+import json
+import shlex
+import sys
+
+from foundationdb_tpu.core.errors import FDBError
+
+
+def parse_key(token):
+    r"""fdbcli key literal → bytes (handles \xNN and \\ escapes)."""
+    out = bytearray()
+    i = 0
+    while i < len(token):
+        c = token[i]
+        if c == "\\" and i + 1 < len(token):
+            n = token[i + 1]
+            if n == "x" and i + 3 < len(token):
+                out.append(int(token[i + 2 : i + 4], 16))
+                i += 4
+                continue
+            if n == "\\":
+                out.append(0x5C)
+                i += 2
+                continue
+        out.append(ord(c))
+        i += 1
+    return bytes(out)
+
+
+def format_key(b):
+    """bytes → fdbcli display literal."""
+    out = []
+    for byte in b:
+        if 32 <= byte < 127 and byte != 0x5C:
+            out.append(chr(byte))
+        elif byte == 0x5C:
+            out.append("\\\\")
+        else:
+            out.append(f"\\x{byte:02x}")
+    return "".join(out)
+
+
+class Cli:
+    def __init__(self, db, out=None):
+        self.db = db
+        self.out = out if out is not None else sys.stdout
+        self.tr = None  # explicit transaction when `begin` is active
+        self.write_mode = False
+
+    def _p(self, *lines):
+        for ln in lines:
+            print(ln, file=self.out)
+
+    def _run(self, fn):
+        """Run against the explicit txn if one is open, else one-shot."""
+        if self.tr is not None:
+            return fn(self.tr)
+        return self.db.run(fn)
+
+    def repl(self, in_=None):
+        in_ = in_ if in_ is not None else sys.stdin
+        self._p("Welcome to the foundationdb_tpu CLI. Type `help` for help.")
+        while True:
+            print("fdb> ", end="", flush=True, file=self.out)
+            line = in_.readline()
+            if not line:
+                break
+            if not self.run_command(line.strip()):
+                break
+
+    def run_command(self, line):
+        """Execute one command line. Returns False on exit/quit."""
+        if not line or line.startswith("#"):
+            return True
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            self._p(f"ERROR: {e}")
+            return True
+        cmd, args = parts[0].lower(), parts[1:]
+        if cmd in ("exit", "quit"):
+            return False
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            self._p(f"ERROR: Unknown command `{cmd}'. Try `help'.")
+            return True
+        try:
+            handler(args)
+        except FDBError as e:
+            self._p(f"ERROR: {e} ({e.code})")
+        except (ValueError, IndexError) as e:
+            self._p(f"ERROR: {e}")
+        return True
+
+    # ── commands (ref: fdbcli command table) ──
+    def _cmd_help(self, args):
+        self._p(
+            "Commands:",
+            "  get KEY                         read a key",
+            "  set KEY VALUE                   write a key (writemode on)",
+            "  clear KEY                       clear a key (writemode on)",
+            "  clearrange BEGIN END            clear a range (writemode on)",
+            "  getrange BEGIN [END] [LIMIT]    read a key range",
+            "  getrangekeys BEGIN [END] [LIMIT] read keys only",
+            "  writemode on|off                allow mutations",
+            "  begin / commit / reset          explicit transaction",
+            "  getversion                      current read version",
+            "  status [json]                   cluster status",
+            "  tenant create|delete|list|get   manage tenants",
+            "  option ...                      accepted, no-op",
+            "  exit / quit",
+        )
+
+    def _need_write(self):
+        if not self.write_mode:
+            raise ValueError(
+                "writemode must be enabled to set or clear keys"
+            )
+
+    def _cmd_writemode(self, args):
+        self.write_mode = args and args[0] == "on"
+
+    def _cmd_get(self, args):
+        key = parse_key(args[0])
+        val = self._run(lambda tr: tr.get(key))
+        if val is None:
+            self._p(f"`{format_key(key)}': not found")
+        else:
+            self._p(f"`{format_key(key)}' is `{format_key(val)}'")
+
+    def _cmd_set(self, args):
+        self._need_write()
+        key, val = parse_key(args[0]), parse_key(args[1])
+        self._run(lambda tr: tr.set(key, val))
+        self._p("Committed" if self.tr is None else "Staged")
+
+    def _cmd_clear(self, args):
+        self._need_write()
+        key = parse_key(args[0])
+        self._run(lambda tr: tr.clear(key))
+        self._p("Committed" if self.tr is None else "Staged")
+
+    def _cmd_clearrange(self, args):
+        self._need_write()
+        b, e = parse_key(args[0]), parse_key(args[1])
+        self._run(lambda tr: tr.clear_range(b, e))
+        self._p("Committed" if self.tr is None else "Staged")
+
+    def _cmd_getrange(self, args, keys_only=False):
+        b = parse_key(args[0])
+        e = parse_key(args[1]) if len(args) > 1 else b"\xff"
+        limit = int(args[2]) if len(args) > 2 else 25
+        rows = self._run(lambda tr: tr.get_range(b, e, limit=limit))
+        self._p("Range limited to {} keys".format(limit))
+        for k, v in rows:
+            if keys_only:
+                self._p(f"`{format_key(k)}'")
+            else:
+                self._p(f"`{format_key(k)}' is `{format_key(v)}'")
+
+    def _cmd_getrangekeys(self, args):
+        self._cmd_getrange(args, keys_only=True)
+
+    def _cmd_begin(self, args):
+        if self.tr is not None:
+            self._p("ERROR: Already in a transaction")
+            return
+        self.tr = self.db.create_transaction()
+        self._p("Transaction started")
+
+    def _cmd_commit(self, args):
+        if self.tr is None:
+            self._p("ERROR: No active transaction")
+            return
+        self.tr.commit()
+        self._p(f"Committed ({self.tr.get_committed_version()})")
+        self.tr = None
+
+    def _cmd_reset(self, args):
+        if self.tr is not None:
+            self.tr.reset()
+        self.tr = None
+        self._p("Transaction reset")
+
+    def _cmd_getversion(self, args):
+        self._p(str(self.db.create_transaction().get_read_version()))
+
+    def _cmd_status(self, args):
+        st = self.db.status()
+        if args and args[0] == "json":
+            self._p(json.dumps(st, indent=2))
+            return
+        c = st["cluster"]
+        w = c["workload"]["transactions"]
+        self._p(
+            "Configuration:",
+            f"  Coordinators        - {c.get('coordinators', 1)}",
+            f"  Resolvers           - {c['resolvers']} "
+            f"(backend: {c['resolver_backend']})",
+            f"  Storage servers     - {c['storage_servers']}",
+            f"  Shards              - {c.get('data', {}).get('shards', 1)}",
+            "Workload:",
+            f"  Started             - {w['started']['counter']}",
+            f"  Committed           - {w['committed']['counter']}",
+            f"  Conflicted          - {w['conflicted']['counter']}",
+            f"Generation: {c['generation']}",
+            f"Latest version: {c['latest_version']}",
+        )
+
+    def _cmd_option(self, args):
+        self._p("Option enabled for all transactions")
+
+    def _cmd_tenant(self, args):
+        from foundationdb_tpu.layers.tenant import TenantManagement as TM
+
+        sub = args[0]
+        if sub == "create":
+            TM.create_tenant(self.db, parse_key(args[1]))
+            self._p(f"The tenant `{args[1]}' has been created")
+        elif sub == "delete":
+            TM.delete_tenant(self.db, parse_key(args[1]))
+            self._p(f"The tenant `{args[1]}' has been deleted")
+        elif sub == "list":
+            for name, _meta in TM.list_tenants(self.db):
+                self._p(format_key(name))
+        elif sub == "get":
+            names = [n for n, _ in TM.list_tenants(self.db)]
+            key = parse_key(args[1])
+            if key in names:
+                self._p(f"The tenant `{args[1]}' exists")
+            else:
+                self._p(f"ERROR: Tenant `{args[1]}' does not exist")
+        else:
+            raise ValueError(f"unknown tenant subcommand {sub}")
+
+
+def main(argv=None):
+    import argparse
+
+    from foundationdb_tpu.server.cluster import Cluster
+
+    ap = argparse.ArgumentParser(prog="fdbcli")
+    ap.add_argument("--exec", dest="exec_cmds", action="append", default=[])
+    ap.add_argument("--wal", default=None, help="WAL path for durability")
+    ns = ap.parse_args(argv)
+
+    db = Cluster(wal_path=ns.wal).database()
+    cli = Cli(db)
+    cli.write_mode = True
+    if ns.exec_cmds:
+        for c in ns.exec_cmds:
+            for sub in c.split(";"):
+                cli.run_command(sub.strip())
+    else:
+        cli.repl()
+
+
+if __name__ == "__main__":
+    main()

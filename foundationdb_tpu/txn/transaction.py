@@ -172,11 +172,11 @@ class Transaction:
             if known:
                 if not needs_base:
                     return self._writes.fold(entry, None)
-                base = self._cluster.storage.get(key, rv)
+                base = self._cluster.read_storage(key).get(key, rv)
                 if not snapshot:
                     self._add_read_conflict(key, key_successor(key))
                 return self._writes.fold(entry, base)
-        val = self._cluster.storage.get(key, rv)
+        val = self._cluster.read_storage(key).get(key, rv)
         if not snapshot:
             self._add_read_conflict(key, key_successor(key))
         return val
@@ -184,7 +184,7 @@ class Transaction:
     def get_key(self, selector, snapshot=False):
         self._guard()
         rv = self.get_read_version()
-        k = self._cluster.storage.resolve_selector(selector, rv)
+        k = self._cluster.read_storage().resolve_selector(selector, rv)
         if not snapshot and k not in (b"", b"\xff"):
             self._add_read_conflict(k, key_successor(k))
         return k
@@ -197,7 +197,7 @@ class Transaction:
         """
         self._guard()
         rv = self.get_read_version()
-        st = self._cluster.storage
+        st = self._cluster.read_storage()
         if begin is None:
             begin = b""
         if end is None:
@@ -407,7 +407,7 @@ class Transaction:
 
     def _activate_watches(self):
         for h in self._watches_pending:
-            h._bind(self._cluster.storage.watch(h.key, h.seen_value))
+            h._bind(self._cluster.read_storage(h.key).watch(h.key, h.seen_value))
         self._watches_pending = []
 
     def on_error(self, error):

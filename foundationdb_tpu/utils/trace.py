@@ -1,0 +1,151 @@
+"""Structured trace events — the framework's observability spine.
+
+Ref parity: flow/Trace.cpp TraceEvent. The reference emits XML/JSON
+trace files per role with severity, type, time, and arbitrary detail
+fields; tooling greps them for forensics. Ours keeps the same shape
+(one JSON object per line) with a process-wide sink, a per-event fluent
+detail API, and severity filtering. In simulation the clock is the
+simulated clock, keeping traces deterministic for a given seed.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+SEV_DEBUG = 5
+SEV_INFO = 10
+SEV_WARN = 20
+SEV_WARN_ALWAYS = 30
+SEV_ERROR = 40
+
+_SEV_NAMES = {
+    SEV_DEBUG: "debug",
+    SEV_INFO: "info",
+    SEV_WARN: "warn",
+    SEV_WARN_ALWAYS: "warn_always",
+    SEV_ERROR: "error",
+}
+
+
+class TraceLog:
+    """Process-wide sink for TraceEvents (ref: g_traceLog)."""
+
+    def __init__(self, path=None, min_severity=SEV_INFO, clock=time.time):
+        self._lock = threading.Lock()
+        self._path = path
+        self._file = None
+        self._buffer = []  # kept in memory when no path (tests, simulation)
+        self.min_severity = min_severity
+        self.clock = clock
+        self.max_buffered = 10_000
+
+    def open(self, path):
+        with self._lock:
+            self._path = path
+            if self._file:
+                self._file.close()
+            self._file = open(path, "a", buffering=1)
+
+    def close(self):
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+    def emit(self, event):
+        if event["severity"] < self.min_severity:
+            return
+        line = json.dumps(event, separators=(",", ":"), default=repr)
+        with self._lock:
+            if self._file is None and self._path is not None:
+                self._file = open(self._path, "a", buffering=1)
+            if self._file is not None:
+                self._file.write(line + "\n")
+            else:
+                self._buffer.append(event)
+                if len(self._buffer) > self.max_buffered:
+                    del self._buffer[: self.max_buffered // 2]
+
+    def events(self, type_=None):
+        """Buffered events (memory sink only), newest last."""
+        with self._lock:
+            return [
+                e for e in self._buffer if type_ is None or e["type"] == type_
+            ]
+
+    def clear(self):
+        with self._lock:
+            self._buffer.clear()
+
+
+_global = TraceLog(
+    path=os.environ.get("FDB_TPU_TRACE_FILE"),
+    min_severity=int(os.environ.get("FDB_TPU_TRACE_SEVERITY", SEV_INFO)),
+)
+
+
+def global_trace_log():
+    return _global
+
+
+class TraceEvent:
+    """Fluent structured event (ref: TraceEvent(\"Type\").detail(...).log()).
+
+    Usage::
+
+        TraceEvent("CommitBatch", severity=SEV_INFO).detail(
+            txns=32, version=cv).log()
+
+    Events also log on ``with``-exit or garbage collection, mirroring the
+    reference's log-on-destruct.
+    """
+
+    def __init__(self, type_, severity=SEV_INFO, log=None):
+        self.type = type_
+        self.severity = severity
+        self._details = {}
+        self._log = log if log is not None else _global
+        self._logged = False
+
+    def detail(self, **kwargs):
+        self._details.update(kwargs)
+        return self
+
+    def error(self, exc):
+        self.severity = max(self.severity, SEV_ERROR)
+        self._details["error"] = str(exc)
+        return self
+
+    def log(self):
+        if self._logged:
+            return
+        self._logged = True
+        self._log.emit(
+            {
+                "type": self.type,
+                "severity": self.severity,
+                "sev_name": _SEV_NAMES.get(self.severity, str(self.severity)),
+                "time": self._log.clock(),
+                **{
+                    k: (v.decode("latin-1") if isinstance(v, bytes) else v)
+                    for k, v in self._details.items()
+                },
+            }
+        )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.error(exc)
+        self.log()
+        return False
+
+    def __del__(self):
+        try:
+            self.log()
+        except Exception:
+            pass

@@ -21,3 +21,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Small kernel shapes for in-process cluster tests: the default knobs size
+# the resolver for TPU throughput (T=1024, 4096-entry ring) — per-commit
+# overkill that makes CPU unit tests crawl. Tests that exercise the commit
+# pipeline pass these unless the test is about capacity itself.
+TEST_KNOBS = dict(
+    batch_txn_capacity=16,
+    point_reads_per_txn=2,
+    point_writes_per_txn=2,
+    range_reads_per_txn=4,
+    range_writes_per_txn=4,
+    key_limbs=4,
+    hash_table_bits=14,
+    range_ring_capacity=64,
+    coarse_buckets_bits=8,
+    initial_backoff_s=0.0001,
+)

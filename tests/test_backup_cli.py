@@ -1,0 +1,217 @@
+"""Backup/restore and fdbcli parity tests.
+
+Models the reference's BackupToFileCorrectness workload: snapshot +
+mutation log, restore to a fresh database, point-in-time restore; and
+fdbcli's scripted --exec usage.
+"""
+
+import io
+
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.tools.backup import BackupAgent, describe_backup, restore
+from foundationdb_tpu.tools.cli import Cli, format_key, parse_key
+
+
+from tests.conftest import TEST_KNOBS
+
+
+def fresh_db():
+    return Cluster(**TEST_KNOBS).database()
+
+
+class TestBackup:
+    def test_snapshot_restore(self, tmp_path):
+        db = fresh_db()
+        for i in range(25):
+            db.set(b"k%02d" % i, b"v%02d" % i)
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        v = agent.snapshot()
+        assert describe_backup(str(tmp_path / "bk"))["snapshot_version"] == v
+
+        db2 = fresh_db()
+        restore(db2, str(tmp_path / "bk"))
+        assert db2.get_range(b"", b"\xff") == [
+            (b"k%02d" % i, b"v%02d" % i) for i in range(25)
+        ]
+
+    def test_log_replay_after_snapshot(self, tmp_path):
+        db = fresh_db()
+        db.set(b"a", b"1")
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        agent.snapshot()
+        # post-snapshot mutations: set, overwrite, atomic, clear
+        db.set(b"b", b"2")
+        db.set(b"a", b"updated")
+        db.add(b"ctr", (7).to_bytes(8, "little"))
+        db.clear(b"gone")
+        agent.pull_log()
+
+        db2 = fresh_db()
+        restore(db2, str(tmp_path / "bk"))
+        assert db2.get(b"a") == b"updated"
+        assert db2.get(b"b") == b"2"
+        assert int.from_bytes(db2.get(b"ctr"), "little") == 7
+
+    def test_point_in_time_restore(self, tmp_path):
+        db = fresh_db()
+        db.set(b"k", b"before")
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        agent.snapshot()
+        db.set(b"k", b"middle")
+        mid = agent.pull_log()
+        db.set(b"k", b"after")
+        agent.pull_log()
+
+        db2 = fresh_db()
+        restore(db2, str(tmp_path / "bk"), target_version=mid)
+        assert db2.get(b"k") == b"middle"
+
+    def test_restore_into_prefix(self, tmp_path):
+        db = fresh_db()
+        db.set(b"k", b"v")
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        agent.snapshot()
+        db2 = fresh_db()
+        restore(db2, str(tmp_path / "bk"), prefix=b"restored/")
+        assert db2.get(b"restored/k") == b"v"
+        assert db2.get(b"k") is None
+
+    def test_clear_range_restores_under_prefix(self, tmp_path):
+        """clear_range end keys must be re-prefixed too, else the restore
+        clears outside the prefix (or aborts on an inverted range)."""
+        db = fresh_db()
+        for i in range(5):
+            db.set(b"p%d" % i, b"x")
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        agent.snapshot()
+        db.clear_range(b"p1", b"p4")
+        agent.pull_log()
+        db2 = fresh_db()
+        db2.set(b"outside", b"untouched")
+        restore(db2, str(tmp_path / "bk"), prefix=b"restored/")
+        assert [k for k, _ in db2.get_range(b"restored/", b"restored0")] == [
+            b"restored/p0", b"restored/p4"]
+        assert db2.get(b"outside") == b"untouched"
+
+    def test_clear_range_in_log(self, tmp_path):
+        db = fresh_db()
+        for i in range(5):
+            db.set(b"p%d" % i, b"x")
+        agent = BackupAgent(db, str(tmp_path / "bk"))
+        agent.snapshot()
+        db.clear_range(b"p1", b"p4")
+        agent.pull_log()
+        db2 = fresh_db()
+        restore(db2, str(tmp_path / "bk"))
+        assert [k for k, _ in db2.get_range(b"p", b"q")] == [b"p0", b"p4"]
+
+
+class TestKeyLiterals:
+    def test_roundtrip(self):
+        for b in (b"plain", b"\x00\xff mix\\ed", bytes(range(40))):
+            assert parse_key(format_key(b)) == b
+
+    def test_hex_escape(self):
+        assert parse_key("\\x00\\xff") == b"\x00\xff"
+
+
+class TestCli:
+    def run(self, db, *cmds, write=True):
+        out = io.StringIO()
+        cli = Cli(db, out=out)
+        cli.write_mode = write
+        for c in cmds:
+            cli.run_command(c)
+        return out.getvalue()
+
+    def test_set_get(self):
+        db = fresh_db()
+        out = self.run(db, "set hello world", "get hello")
+        assert "`hello' is `world'" in out
+        assert db.get(b"hello") == b"world"
+
+    def test_writemode_guard(self):
+        db = fresh_db()
+        out = self.run(db, "set k v", write=False)
+        assert "writemode" in out
+        assert db.get(b"k") is None
+
+    def test_getrange_and_clear(self):
+        db = fresh_db()
+        for i in range(5):
+            db.set(b"k%d" % i, b"v")
+        out = self.run(db, "getrange k0 k9 3")
+        assert out.count("is `v'") == 3
+        self.run(db, "clearrange k0 k3")
+        assert [k for k, _ in db.get_range(b"k", b"l")] == [b"k3", b"k4"]
+
+    def test_explicit_txn(self):
+        db = fresh_db()
+        out = self.run(db, "begin", "set a 1", "set b 2", "commit")
+        assert "Committed (" in out
+        assert db.get(b"a") == b"1" and db.get(b"b") == b"2"
+
+    def test_txn_reset_discards(self):
+        db = fresh_db()
+        self.run(db, "begin", "set a 1", "reset")
+        assert db.get(b"a") is None
+
+    def test_status_and_json(self):
+        db = fresh_db()
+        db.set(b"k", b"v")
+        out = self.run(db, "status")
+        assert "Committed" in out and "Resolvers" in out
+        out = self.run(db, "status json")
+        assert '"database_available": true' in out
+
+    def test_tenant_commands(self):
+        db = fresh_db()
+        out = self.run(db, "tenant create t1", "tenant list", "tenant get t1")
+        assert "has been created" in out and "exists" in out
+
+    def test_unknown_command(self):
+        out = self.run(fresh_db(), "frobnicate")
+        assert "Unknown command" in out
+
+
+class TestTrace:
+    def test_events_and_severity(self):
+        from foundationdb_tpu.utils.trace import (
+            SEV_DEBUG, SEV_ERROR, TraceEvent, TraceLog,
+        )
+
+        log = TraceLog(min_severity=10)
+        TraceEvent("Visible", log=log).detail(x=1, key=b"\xff").log()
+        TraceEvent("Hidden", severity=SEV_DEBUG, log=log).log()
+        evs = log.events()
+        assert [e["type"] for e in evs] == ["Visible"]
+        assert evs[0]["x"] == 1 and evs[0]["key"] == "\xff"
+
+        with TraceEvent("Scoped", log=log) as ev:
+            ev.detail(step="mid")
+        assert log.events("Scoped")[0]["step"] == "mid"
+
+    def test_error_capture(self):
+        from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent, TraceLog
+
+        log = TraceLog()
+        try:
+            with TraceEvent("Boom", log=log):
+                raise RuntimeError("kapow")
+        except RuntimeError:
+            pass
+        ev = log.events("Boom")[0]
+        assert ev["severity"] == SEV_ERROR and "kapow" in ev["error"]
+
+    def test_file_sink(self, tmp_path):
+        import json
+
+        from foundationdb_tpu.utils.trace import TraceEvent, TraceLog
+
+        path = str(tmp_path / "trace.jsonl")
+        log = TraceLog(path=path)
+        TraceEvent("ToDisk", log=log).detail(n=3).log()
+        log.close()
+        with open(path) as f:
+            rec = json.loads(f.readline())
+        assert rec["type"] == "ToDisk" and rec["n"] == 3

@@ -9,18 +9,7 @@ from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.server.cluster import Cluster
 
-TEST_KNOBS = dict(
-    batch_txn_capacity=16,
-    point_reads_per_txn=2,
-    point_writes_per_txn=2,
-    range_reads_per_txn=4,
-    range_writes_per_txn=4,
-    key_limbs=4,
-    hash_table_bits=14,
-    range_ring_capacity=64,
-    coarse_buckets_bits=8,
-    initial_backoff_s=0.0001,
-)
+from tests.conftest import TEST_KNOBS
 
 
 @pytest.fixture()
