@@ -12,7 +12,7 @@ import dataclasses
 @dataclasses.dataclass
 class Knobs:
     # --- resolver ---
-    resolver_backend: str = "tpu"  # "tpu" | "cpu"
+    resolver_backend: str = "tpu"  # "tpu" | "cpu" (python) | "native" (C++)
     batch_txn_capacity: int = 1024  # T: txns per resolver batch (static shape)
     point_reads_per_txn: int = 4  # PR
     point_writes_per_txn: int = 4  # PW

@@ -1,0 +1,152 @@
+"""Native runtime pieces: the C++ ConflictSet behind resolver_backend="native".
+
+Builds conflict_set.cpp with g++ on first use (cached as a .so beside the
+source; rebuilt when the source is newer) and binds it with ctypes — no
+pybind11 dependency. The batch ABI moves whole commit batches across the
+FFI boundary in packed numpy arrays, mirroring how the TPU path packs
+batches into device arrays (resolver/packing.py).
+
+Ref parity: fdbserver/SkipList.cpp ConflictSet (role), bindings/c (the
+C-ABI shape of the reference's native surface).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "conflict_set.cpp")
+_SO = os.path.join(_HERE, "libconflictset.so")
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build():
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise NativeBuildError("g++ not available") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeBuildError(f"native build failed:\n{e.stderr}") from e
+
+
+def load_library():
+    """Build (if stale) and load the native library; cached per process."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            _build()
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/foreign-arch artifact (e.g. restored by checkout with
+            # a tie mtime): rebuild from source and retry once
+            os.unlink(_SO)
+            _build()
+            lib = ctypes.CDLL(_SO)
+        lib.ccs_new.restype = ctypes.c_void_p
+        lib.ccs_free.argtypes = [ctypes.c_void_p]
+        lib.ccs_window_start.argtypes = [ctypes.c_void_p]
+        lib.ccs_window_start.restype = ctypes.c_uint64
+        lib.ccs_segment_count.argtypes = [ctypes.c_void_p]
+        lib.ccs_segment_count.restype = ctypes.c_uint64
+        lib.ccs_prune.argtypes = [ctypes.c_void_p]
+        lib.ccs_resolve_batch.argtypes = [
+            ctypes.c_void_p,  # set
+            ctypes.c_char_p,  # blob
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,  # reads
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,  # writes
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,  # read versions
+            ctypes.c_uint64, ctypes.c_uint64,  # commit v, window
+            ctypes.POINTER(ctypes.c_uint8),  # statuses out
+        ]
+        _lib = lib
+        return lib
+
+
+def native_available():
+    try:
+        load_library()
+        return True
+    except (NativeBuildError, OSError):
+        return False
+
+
+_STATUS_MAP = {0: COMMITTED, 1: CONFLICT, 2: TOO_OLD}
+
+
+class NativeConflictSet:
+    """Drop-in twin of resolver.skiplist.CpuConflictSet on the C++ core."""
+
+    def __init__(self):
+        self._lib = load_library()
+        self._ptr = ctypes.c_void_p(self._lib.ccs_new())
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr:
+            self._lib.ccs_free(ptr)
+
+    @property
+    def window_start(self):
+        return self._lib.ccs_window_start(self._ptr)
+
+    @property
+    def segment_count(self):
+        return self._lib.ccs_segment_count(self._ptr)
+
+    def prune(self):
+        """Immediate GC of out-of-window segments (normally amortized)."""
+        self._lib.ccs_prune(self._ptr)
+
+    def resolve(self, txns, commit_version, new_window_start=None):
+        """Resolve a batch in arrival order; returns list of statuses."""
+        blob = bytearray()
+        reads, writes = [], []
+
+        def pack(ranges, out, t):
+            for b, e in ranges:
+                bo = len(blob)
+                blob.extend(b)
+                eo = len(blob)
+                blob.extend(e)
+                out.append((t, bo, len(b), eo, len(e)))
+
+        rvs = np.empty(len(txns), np.uint64)
+        for t, txn in enumerate(txns):
+            rvs[t] = txn.read_version
+            pack(txn.read_ranges(), reads, t)
+            pack(txn.write_ranges(), writes, t)
+
+        r_arr = np.asarray(reads, np.int64).reshape(-1, 5)
+        w_arr = np.asarray(writes, np.int64).reshape(-1, 5)
+        statuses = np.empty(len(txns), np.uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        self._lib.ccs_resolve_batch(
+            self._ptr,
+            bytes(blob),
+            r_arr.ctypes.data_as(i64p), len(reads),
+            w_arr.ctypes.data_as(i64p), len(writes),
+            rvs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(txns),
+            commit_version,
+            new_window_start if new_window_start is not None else 0,
+            statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return [_STATUS_MAP[s] for s in statuses.tolist()]

@@ -45,12 +45,19 @@ class Resolver:
         elif self.backend == "cpu":
             self.cset = CpuConflictSet()
             self.cset.window_start = base_version
+        elif self.backend == "native":
+            from foundationdb_tpu.native import NativeConflictSet
+
+            self.cset = NativeConflictSet()
+            if base_version:
+                # windows only move forward; an empty resolve installs it
+                self.cset.resolve([], 0, base_version)
         else:
             raise ValueError(f"unknown resolver_backend {self.backend!r}")
 
     def resolve(self, txns, commit_version, new_window_start):
         """txns: list[TxnRequest] in arrival order → list of statuses."""
-        if self.backend == "cpu":
+        if self.backend in ("cpu", "native"):
             return self.cset.resolve(txns, commit_version, new_window_start)
         self._maybe_rebase(commit_version)
         # base_version only ever advances to a past window start, so a read
@@ -95,6 +102,6 @@ class Resolver:
         self.base_version += delta
 
     def window_start(self):
-        if self.backend == "cpu":
+        if self.backend in ("cpu", "native"):
             return self.cset.window_start
         return self.base_version + int(jax.device_get(self.state.window_start))
