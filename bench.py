@@ -50,6 +50,7 @@ def zipfian_sampler(nkeys, theta, rng):
 
 
 def build_batches(params, nbatches, nkeys, theta, seed=0):
+    """YCSB-A point batches: 50/50 read/update, Zipfian key choice."""
     from foundationdb_tpu.ops.conflict import ResolveBatch
     from foundationdb_tpu.resolver.packing import bucket_of, fnv_hash_np
 
@@ -96,6 +97,55 @@ def build_batches(params, nbatches, nkeys, theta, seed=0):
     return batches
 
 
+def build_range_batches(params, nbatches, nkeys, theta, seed=0,
+                        scan_span=8, clear_span=4):
+    """Range-heavy batches (the 'Range-heavy: getRange scans + clearRange
+    writes' config in BASELINE.json): 50% short scans (range reads), 50%
+    clearRange-style range writes, Zipfian start keys. Exercises the
+    ring + coarse interval lanes and intra-batch range/range conflicts."""
+    from foundationdb_tpu.ops.conflict import ResolveBatch
+    from foundationdb_tpu.resolver.packing import bucket_of, fnv_hash_np
+
+    rng = np.random.default_rng(seed)
+    T, W = params.txns, params.key_width
+    keys = make_key_table(nkeys, params.key_width - 1)
+    buckets = bucket_of(keys, params.bucket_bits)
+    sample = zipfian_sampler(nkeys, theta, rng)
+
+    batches = []
+    cv = 10_000_000
+    empty = lambda *s: np.zeros(s, np.uint32)
+    empty_i = lambda *s: np.zeros(s, np.int32)
+    empty_b = lambda *s: np.zeros(s, bool)
+    for _ in range(nbatches):
+        cv += T
+        start = sample(T)
+        is_scan = rng.random(T) < 0.5
+        span = np.where(is_scan, scan_span, clear_span)
+        end = np.minimum(start + span, nkeys - 1)
+        lag = rng.integers(0, 1000, T).astype(np.uint32)
+        rv = (np.uint32(cv - 1) - lag).astype(np.uint32)
+        batches.append(
+            ResolveBatch(
+                rv=rv,
+                txn_mask=np.ones(T, bool),
+                pr_hash=empty(T, 0), pr_key=empty(T, 0, W),
+                pr_bucket=empty_i(T, 0), pr_mask=empty_b(T, 0),
+                pw_hash=empty(T, 0), pw_key=empty(T, 0, W),
+                pw_bucket=empty_i(T, 0), pw_mask=empty_b(T, 0),
+                rr_b=keys[start][:, None, :], rr_e=keys[end][:, None, :],
+                rr_lo=buckets[start][:, None], rr_hi=buckets[end][:, None],
+                rr_mask=is_scan[:, None],
+                rw_b=keys[start][:, None, :], rw_e=keys[end][:, None, :],
+                rw_lo=buckets[start][:, None], rw_hi=buckets[end][:, None],
+                rw_mask=(~is_scan)[:, None],
+                cv=np.uint32(cv),
+                new_window_start=np.uint32(max(0, cv - 5_000_000)),
+            )
+        )
+    return batches
+
+
 def stack_batches(batches, group):
     """Stack ``group`` consecutive batches along a new leading axis."""
     import jax
@@ -113,6 +163,7 @@ def measure_kernel_step_ms(ck, params, batch, n=30):
 
     step = ck.make_resolve_fn(params, donate=True)
     state = ck.init_state(params)
+    batch = jax.device_put(batch)  # device-only: exclude host→device link
     status, _, state = step(state, batch)
     jax.block_until_ready(status)
     t0 = time.perf_counter()
@@ -128,15 +179,17 @@ def main():
     from foundationdb_tpu.ops import conflict as ck
 
     env = os.environ.get
+    mode = env("BENCH_MODE", "point")  # point (YCSB-A) | range (scan+clear)
+    point = mode == "point"
     params = ck.ResolverParams(
-        txns=int(env("BENCH_TXNS", 8192)),
-        point_reads=1,
-        point_writes=1,
-        range_reads=0,
-        range_writes=0,
+        txns=int(env("BENCH_TXNS", 8192 if point else 2048)),
+        point_reads=1 if point else 0,
+        point_writes=1 if point else 0,
+        range_reads=0 if point else 1,
+        range_writes=0 if point else 1,
         key_width=5,
         hash_bits=int(env("BENCH_HASH_BITS", 23)),  # 8M slots: FP ~1e-4
-        ring_capacity=8192,
+        ring_capacity=int(env("BENCH_RING", 8192)),
         bucket_bits=14,
     )
     nkeys = int(env("BENCH_KEYS", 1_000_000))
@@ -145,7 +198,8 @@ def main():
     group = int(env("BENCH_SCAN", 8))  # batches per dispatch
     lag = int(env("BENCH_LAG", 4))  # megabatches in flight before readback
 
-    batches = build_batches(params, nbatches, nkeys, theta=0.99)
+    build = build_batches if point else build_range_batches
+    batches = build(params, nbatches, nkeys, theta=0.99)
     megas = stack_batches(batches, group)
     step = ck.make_resolve_scan_fn(params, donate=True)
     state = ck.init_state(params)
@@ -201,7 +255,8 @@ def main():
     # divided by the batches per dispatch
     deltas = np.diff(np.array(marks)) / group * 1e3 if len(marks) > 2 else np.array([batch_ms])
     out = {
-        "metric": "resolved_txns_per_sec_ycsb_a_zipfian99",
+        "metric": "resolved_txns_per_sec_ycsb_a_zipfian99" if point
+        else "resolved_txns_per_sec_range_heavy_zipfian99",
         "value": round(throughput, 1),
         "unit": "txns/sec",
         "vs_baseline": round(throughput / BASELINE_TXNS_PER_SEC, 3),

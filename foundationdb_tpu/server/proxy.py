@@ -41,6 +41,8 @@ class CommitProxy:
         self.dd = dd  # data distribution byte accounting
         self.commit_count = 0
         self.conflict_count = 0
+        self._batches_since_pump = 0
+        self.pump_interval = 64  # batches between flush + ratekeeper rounds
 
     def commit(self, request):
         """Single-transaction batch (the synchronous client path)."""
@@ -108,7 +110,28 @@ class CommitProxy:
         self.sequencer.report_committed(cv)
         if self.ratekeeper is not None:
             self.ratekeeper.observe_commit(len(requests), batch_conflicts)
+        self._batches_since_pump += 1
+        if self._batches_since_pump >= self.pump_interval:
+            self._batches_since_pump = 0
+            self._pump_durability(window)
         return results
+
+    def _pump_durability(self, window):
+        """Periodic updateStorage analog: fold versions that left the MVCC
+        window into the persistent engines, then feed the ratekeeper the
+        durability lag (how far the slowest storage is behind the
+        flushable frontier — the reference's storage-queue signal).
+        The lag is measured BEFORE flushing: it is the backlog this pump
+        found, which is what admission control must react to (after a
+        synchronous flush it would always read zero)."""
+        lag = max(
+            0, window - min(s.durable_version for s in self.storages)
+        )
+        for s in self.storages:
+            s.flush(window)
+        self.tlog.pop(min(s.durable_version for s in self.storages))
+        if self.ratekeeper is not None:
+            self.ratekeeper.update(storage_lag_versions=lag)
 
     def _resolve(self, txns, cv, window):
         if len(self.resolvers) == 1:

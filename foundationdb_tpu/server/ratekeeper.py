@@ -1,17 +1,33 @@
 """Ratekeeper: cluster-wide admission control.
 
 Ref parity: fdbserver/Ratekeeper.actor.cpp — computes a transactions-per-
-second budget from storage/tlog lag and conflict rates; GRV proxies
-enforce it by delaying read-version grants. Here the budget is a token
-bucket refilled from a smoothed target rate, adjusted down when commit
-latency or conflict ratio spikes.
+second budget from storage/tlog health and conflict rates; GRV proxies
+enforce it by delaying or rejecting read-version grants. Ours keeps the
+same two-loop shape:
+
+* a **token bucket** at the GRV edge (``admit``), refilled at the current
+  target TPS, with batch-priority txns charged more so they only run on
+  spare capacity and immediate-priority (system) txns exempt;
+* a **control loop** (``update``, pumped by the cluster or simulation)
+  that recomputes the target: storage durability lag (versions the
+  storage tier is behind the committed version — the analog of the
+  reference's storage-queue spring) squeezes the budget smoothly toward
+  a floor, and a high conflict ratio (wasted work under contention)
+  trims it, recovering multiplicatively when health returns.
 """
 
 import time
 
 
 class Ratekeeper:
+    # lag (in versions) where the budget starts shrinking / hits the floor
+    LAG_SOFT = 1_000_000  # ~1s at 1M versions/sec (the reference's 5s MVCC
+    LAG_HARD = 4_000_000  # window leaves ~1s headroom before TOO_OLD pain)
+    CONFLICT_TRIM = 0.5  # conflict ratio above which the budget is trimmed
+    FLOOR_FRACTION = 0.01
+
     def __init__(self, target_tps=1e9, batch_priority_fraction=0.5):
+        self.max_tps = target_tps
         self.target_tps = target_tps
         self.batch_priority_fraction = batch_priority_fraction
         self._tokens = target_tps
@@ -19,6 +35,7 @@ class Ratekeeper:
         self._recent_txns = 0
         self._recent_conflicts = 0
 
+    # ── GRV-edge enforcement (ref: GrvProxy transaction budgets) ──
     def admit(self, priority="default"):
         now = time.monotonic()
         self._tokens = min(
@@ -41,5 +58,43 @@ class Ratekeeper:
         self._recent_txns += txns
         self._recent_conflicts += conflicts
 
+    # ── control loop (ref: Ratekeeper::updateRate) ──
+    def update(self, storage_lag_versions=0):
+        """Recompute target TPS from tier health; returns the new target.
+
+        ``storage_lag_versions``: committed version minus the slowest
+        storage's durable version (the cluster computes it; simulation
+        pumps this deterministically).
+        """
+        floor = self.max_tps * self.FLOOR_FRACTION
+        # storage spring: full rate below LAG_SOFT, linear squeeze to the
+        # floor at LAG_HARD (the reference's smoothed storage queue term)
+        if storage_lag_versions <= self.LAG_SOFT:
+            lag_target = self.max_tps
+        elif storage_lag_versions >= self.LAG_HARD:
+            lag_target = floor
+        else:
+            frac = (storage_lag_versions - self.LAG_SOFT) / (
+                self.LAG_HARD - self.LAG_SOFT
+            )
+            lag_target = self.max_tps - frac * (self.max_tps - floor)
+
+        # conflict trim: mostly-wasted work means admitting more txns only
+        # manufactures retries; shed a third, recover gradually when healthy
+        target = min(lag_target, self.max_tps)
+        total = self._recent_txns
+        if total >= 100:
+            ratio = self._recent_conflicts / total
+            if ratio > self.CONFLICT_TRIM:
+                target = max(floor, min(target, self.target_tps * (2 / 3)))
+            self._recent_txns = 0
+            self._recent_conflicts = 0
+        if target > self.target_tps:
+            # recover at most 10% per round so oscillation damps out
+            target = min(target, max(self.target_tps * 1.1, floor))
+        self.target_tps = max(floor, target)
+        return self.target_tps
+
     def set_target_tps(self, tps):
-        self.target_tps = float(tps)
+        self.max_tps = float(tps)
+        self.target_tps = min(self.target_tps, self.max_tps)

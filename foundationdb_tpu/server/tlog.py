@@ -19,6 +19,7 @@ class TLog:
         self.wal_path = wal_path
         self.fsync = fsync
         self._wal = open(wal_path, "ab") if wal_path else None
+        self._pop_holds = {}  # name -> version: keep records > version
 
     def push(self, version, mutations):
         if self._log and version <= self._log[-1][0]:
@@ -36,8 +37,20 @@ class TLog:
         """All records with version > from_version, in order."""
         return [(v, m) for v, m in self._log if v > from_version]
 
+    def hold_pop(self, name, version):
+        """Register a peek cursor: records newer than ``version`` survive
+        pop until the holder advances or releases (ref: backup workers'
+        pop locks on the tlog)."""
+        self._pop_holds[name] = version
+
+    def release_pop(self, name):
+        self._pop_holds.pop(name, None)
+
     def pop(self, up_to_version):
-        """Discard records <= up_to_version (applied durably downstream)."""
+        """Discard records <= up_to_version (applied durably downstream),
+        clamped so no registered peek cursor loses unread records."""
+        if self._pop_holds:
+            up_to_version = min(up_to_version, *self._pop_holds.values())
         self._log = [(v, m) for v, m in self._log if v > up_to_version]
         self._first_version = max(self._first_version, up_to_version)
 

@@ -62,11 +62,17 @@ class BackupAgent:
                     break
                 begin = rows[-1][0] + b"\x00"
         self.snapshot_version = v
-        # the log must cover (snapshot_version, target]; start it here
+        # the log must cover (snapshot_version, target]; start it here and
+        # pin the tlog so durability pops cannot outrun our pulls
         self._log_from = v
         self._log_through = v
+        self.db._cluster.tlog.hold_pop(f"backup@{id(self)}", v)
         self._write_manifest()
         return v
+
+    def stop(self):
+        """Release the tlog pin (backup discontinued or complete)."""
+        self.db._cluster.tlog.release_pop(f"backup@{id(self)}")
 
     # ── continuous log (ref: backup workers popping the tlog) ──
     def pull_log(self):
@@ -92,6 +98,7 @@ class BackupAgent:
                     + "\n"
                 )
                 self._log_through = version
+        tlog.hold_pop(f"backup@{id(self)}", self._log_through)
         self._write_manifest()
         return self._log_through
 

@@ -47,7 +47,10 @@ def test_differential_vs_oracle(seed):
         cv += 10
         window = max(0, cv - 200)
         txns = [
-            random_txn(rng, rng.randrange(max(1, cv - 150), cv))
+            # rv range deliberately dips below the window so the TOO_OLD
+            # path (and its interplay with conflicts) is differentially
+            # covered, not just COMMITTED/CONFLICT
+            random_txn(rng, rng.randrange(max(1, cv - 280), cv))
             for _ in range(rng.randrange(1, 12))
         ]
         got = cpp.resolve(txns, cv, window)
