@@ -211,20 +211,29 @@ def resolve_batch(
             return x
 
     else:
-        shard_idx = jax.lax.axis_index(axis_name)
-        mesh_n = jax.lax.axis_size(axis_name)
+        # axis_name may be a tuple (hybrid host×chip mesh: state shards
+        # over every axis; the flattened coordinate is the shard id and
+        # collectives reduce over all of them — psum/pmax take tuples
+        # natively, the index/size just need the row-major fold)
+        names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+        shard_idx = jnp.int32(0)
+        mesh_n = 1
+        for nm in names:
+            sz = jax.lax.axis_size(nm)
+            shard_idx = shard_idx * sz + jax.lax.axis_index(nm)
+            mesh_n *= sz
         if n_shards != mesh_n:
             raise ValueError(
-                f"n_shards={n_shards} does not match mesh axis "
-                f"{axis_name!r} size {mesh_n}: ownership masks would "
+                f"n_shards={n_shards} does not match mesh axes "
+                f"{names!r} total size {mesh_n}: ownership masks would "
                 "silently un-own part of the key space"
             )
 
         def por(x):
-            return jax.lax.psum(x.astype(jnp.int32), axis_name) > 0
+            return jax.lax.psum(x.astype(jnp.int32), names) > 0
 
         def pmax_arr(x):
-            return jax.lax.pmax(x, axis_name)
+            return jax.lax.pmax(x, names)
 
     C = 1 << params.bucket_bits
 
@@ -443,6 +452,23 @@ def make_resolve_fn(params: ResolverParams, donate=True):
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+def scan_of(step_fn):
+    """Lift a single-batch resolver step into a multi-batch scan:
+    (state, batches[B, ...]) → (state, statuses[B, T]), the history
+    threaded sequentially exactly as B successive calls would. Shared by
+    the single-device and shard_map paths so the scan semantics cannot
+    diverge between them."""
+
+    def scan_step(state, batches):
+        def body(s, b):
+            status, _accepted, s2 = step_fn(s, b)
+            return s2, status
+
+        return jax.lax.scan(body, state, batches)
+
+    return scan_step
+
+
 def make_resolve_scan_fn(params: ResolverParams, donate=True):
     """jit-compiled *multi-batch* resolver step: ``lax.scan`` threads the
     history through a stack of batches (leading axis B) in one dispatch.
@@ -456,14 +482,7 @@ def make_resolve_scan_fn(params: ResolverParams, donate=True):
     Returns (state, statuses[B, T]).
     """
     validate_params(params)
-
-    def scan_step(state, batches):
-        def body(s, b):
-            status, _accepted, s2 = resolve_batch(s, b, params)
-            return s2, status
-
-        return jax.lax.scan(body, state, batches)
-
+    scan_step = scan_of(lambda s, b: resolve_batch(s, b, params))
     return jax.jit(scan_step, donate_argnums=(0,) if donate else ())
 
 

@@ -1,0 +1,84 @@
+"""Multi-host deployment: process groups and hybrid ICI/DCN meshes.
+
+Ref parity: the role FlowTransport + cluster connection strings play in
+the reference — how N machines become one transaction system — mapped
+to JAX's runtime: ``jax.distributed`` forms the process group (the
+coordinator is the analog of the cluster file's coordinators for
+*compute* membership), and a hybrid ``Mesh`` lays out resolver shards so
+the verdict collectives (psum/pmax in ops/conflict.py) ride ICI within a
+host's chips and only the small reductions cross DCN between hosts.
+
+Single-process use is a no-op: every helper degrades to the local
+devices, so the same code runs on a laptop CPU mesh, one TPU host, or a
+multi-host slice.
+"""
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from foundationdb_tpu.parallel.mesh import AXIS
+
+HOST_AXIS = "hosts"
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               **kw):
+    """Join (or form) the multi-host process group.
+
+    Mirrors ``jax.distributed.initialize`` but is safe to call
+    unconditionally: with no coordinator configured (args or
+    JAX_COORDINATOR_ADDRESS / standard cluster env), it is a no-op and
+    the framework stays single-process. Returns (process_index,
+    process_count).
+    """
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    # decide from configuration alone — touching any device API first
+    # (even process_count()) initializes the XLA backend, after which
+    # jax.distributed.initialize refuses to run
+    if addr and jax._src.distributed.global_state.client is None:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kw,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def fleet_mesh(n_devices=None):
+    """A resolver-fleet mesh spanning every process's devices.
+
+    Multi-host: a 2-D ('hosts', 'rs') mesh — hosts over DCN, each host's
+    chips over ICI — built so that consecutive 'rs' coordinates stay on
+    one host (collectives over 'rs' never leave ICI). Single-host: the
+    flat 1-D ('rs',) mesh from parallel.mesh.
+    """
+    if jax.process_count() <= 1:
+        devs = jax.devices()
+        if n_devices is not None:
+            devs = devs[:n_devices]
+        return Mesh(np.array(devs), (AXIS,))
+    per_host = jax.local_device_count()
+    total = jax.process_count() * per_host
+    if n_devices is not None and n_devices != total:
+        raise ValueError(
+            f"n_devices={n_devices} cannot subset a multi-host fleet of "
+            f"{total} devices: every host's chips participate in the mesh"
+        )
+    grid = np.array(jax.devices()).reshape(jax.process_count(), per_host)
+    return Mesh(grid, (HOST_AXIS, AXIS))
+
+
+def shard_axes(mesh):
+    """The mesh axes conflict state shards over.
+
+    On a hybrid mesh the history shards across BOTH axes (every chip in
+    the fleet owns a slice), so specs use ('hosts', 'rs') where the flat
+    mesh uses 'rs'.
+    """
+    return (
+        (HOST_AXIS, AXIS) if HOST_AXIS in mesh.axis_names else (AXIS,)
+    )

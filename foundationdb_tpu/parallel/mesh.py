@@ -30,17 +30,17 @@ def default_mesh(n_devices=None):
     return Mesh(np.array(devs), (AXIS,))
 
 
-def _state_specs():
+def _state_specs(axes=AXIS):
     return ck.ResolverState(
         window_start=P(),  # replicated scalar
-        ht=P(AXIS),
-        ring_b=P(AXIS),
-        ring_e=P(AXIS),
-        ring_v=P(AXIS),
-        ring_lo=P(AXIS),
-        ring_hi=P(AXIS),
-        ring_mask=P(AXIS),
-        ring_head=P(AXIS),  # [n] — one cursor per shard
+        ht=P(axes),
+        ring_b=P(axes),
+        ring_e=P(axes),
+        ring_v=P(axes),
+        ring_lo=P(axes),
+        ring_hi=P(axes),
+        ring_mask=P(axes),
+        ring_head=P(axes),  # [n] — one cursor per shard
         range_L=P(),  # replicated coarse summaries (pmax-synced)
         range_R=P(),
         point_coarse=P(),
@@ -65,38 +65,55 @@ class ShardedResolverKernel:
         self.params = params
         self.mesh = mesh if mesh is not None else default_mesh()
         self.n = self.mesh.devices.size
+        # hybrid host×chip meshes (parallel/distributed.py) shard state
+        # over every axis; the flat single-host mesh over the one axis
+        self.axes = tuple(self.mesh.axis_names)
+        self.spec_axes = self.axes if len(self.axes) > 1 else self.axes[0]
 
         fn = functools.partial(
-            ck.resolve_batch, params=params, axis_name=AXIS, n_shards=self.n
+            ck.resolve_batch, params=params, axis_name=self.spec_axes,
+            n_shards=self.n,
         )
         sharded = jax.shard_map(
             fn,
             mesh=self.mesh,
-            in_specs=(_state_specs(), _batch_specs()),
-            out_specs=(P(), P(), _state_specs()),
+            in_specs=(_state_specs(self.spec_axes), _batch_specs()),
+            out_specs=(P(), P(), _state_specs(self.spec_axes)),
             check_vma=False,
         )
         self._step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+        scan_sharded = jax.shard_map(
+            ck.scan_of(fn),
+            mesh=self.mesh,
+            in_specs=(_state_specs(self.spec_axes), _batch_specs()),
+            out_specs=(_state_specs(self.spec_axes), P()),
+            check_vma=False,
+        )
+        self._scan_step = jax.jit(
+            scan_sharded, donate_argnums=(0,) if donate else ()
+        )
         self.state = self.init_state()
 
     def init_state(self):
         p, n = self.params, self.n
         kr, c, w = p.ring_capacity, 1 << p.bucket_bits, p.key_width
         u32 = jnp.uint32
+        axes = self.spec_axes
 
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
         return ck.ResolverState(
             window_start=put(jnp.zeros((), u32), P()),
-            ht=put(jnp.zeros((n << p.hash_bits,), u32), P(AXIS)),
-            ring_b=put(jnp.zeros((n * kr, w), u32), P(AXIS)),
-            ring_e=put(jnp.zeros((n * kr, w), u32), P(AXIS)),
-            ring_v=put(jnp.zeros((n * kr,), u32), P(AXIS)),
-            ring_lo=put(jnp.zeros((n * kr,), jnp.int32), P(AXIS)),
-            ring_hi=put(jnp.zeros((n * kr,), jnp.int32), P(AXIS)),
-            ring_mask=put(jnp.zeros((n * kr,), bool), P(AXIS)),
-            ring_head=put(jnp.zeros((n,), jnp.int32), P(AXIS)),
+            ht=put(jnp.zeros((n << p.hash_bits,), u32), P(axes)),
+            ring_b=put(jnp.zeros((n * kr, w), u32), P(axes)),
+            ring_e=put(jnp.zeros((n * kr, w), u32), P(axes)),
+            ring_v=put(jnp.zeros((n * kr,), u32), P(axes)),
+            ring_lo=put(jnp.zeros((n * kr,), jnp.int32), P(axes)),
+            ring_hi=put(jnp.zeros((n * kr,), jnp.int32), P(axes)),
+            ring_mask=put(jnp.zeros((n * kr,), bool), P(axes)),
+            ring_head=put(jnp.zeros((n,), jnp.int32), P(axes)),
             range_L=put(jnp.zeros((c,), u32), P()),
             range_R=put(jnp.zeros((c,), u32), P()),
             point_coarse=put(jnp.zeros((c,), u32), P()),
@@ -105,3 +122,10 @@ class ShardedResolverKernel:
     def resolve(self, batch: ck.ResolveBatch):
         status, accepted, self.state = self._step(self.state, batch)
         return status, accepted
+
+    def resolve_many(self, batches: ck.ResolveBatch):
+        """Resolve a stack of batches (leading axis B) in one dispatch:
+        lax.scan inside the sharded program, so the whole fleet stays on
+        device for B consecutive commit batches. Returns statuses[B, T]."""
+        self.state, statuses = self._scan_step(self.state, batches)
+        return statuses

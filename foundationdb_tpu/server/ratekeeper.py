@@ -80,15 +80,17 @@ class Ratekeeper:
             lag_target = self.max_tps - frac * (self.max_tps - floor)
 
         # conflict trim: mostly-wasted work means admitting more txns only
-        # manufactures retries; shed a third, recover gradually when healthy
+        # manufactures retries; shed a third, recover gradually when healthy.
+        # Counters reset every round — a sub-threshold burst must not
+        # linger and trim some later, healthy period.
         target = min(lag_target, self.max_tps)
         total = self._recent_txns
         if total >= 100:
             ratio = self._recent_conflicts / total
             if ratio > self.CONFLICT_TRIM:
                 target = max(floor, min(target, self.target_tps * (2 / 3)))
-            self._recent_txns = 0
-            self._recent_conflicts = 0
+        self._recent_txns = 0
+        self._recent_conflicts = 0
         if target > self.target_tps:
             # recover at most 10% per round so oscillation damps out
             target = min(target, max(self.target_tps * 1.1, floor))

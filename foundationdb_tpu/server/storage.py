@@ -300,9 +300,11 @@ class StorageServer:
         return w
 
     def advance_window(self, oldest):
+        """Advance the MVCC read floor. Folding old overlay versions into
+        the engine is NOT done here — the commit proxy's periodic
+        durability pump owns flushing (ref: the storage server's
+        updateStorage loop being a separate actor from version updates),
+        so the pump can observe real durability lag and feed it to the
+        ratekeeper instead of hiding it behind a per-batch flush."""
         self.oldest_version = max(self.oldest_version, oldest)
-        # keep the durable tier within the window so overlay memory stays
-        # bounded even without an explicit flush schedule
-        if self.oldest_version > self.durable_version:
-            self.flush(self.oldest_version)
 

@@ -64,6 +64,33 @@ class TestDurabilityPump:
         c.storage.flush()
         assert c.storage.durable_version > 0
 
+    def test_pump_reports_preflush_lag(self):
+        """The lag fed to the ratekeeper must be the backlog found BEFORE
+        flushing — measured after, it is identically zero and admission
+        control can never see storage fall behind."""
+        from foundationdb_tpu.server.cluster import Cluster
+
+        from tests.conftest import TEST_KNOBS
+
+        c = Cluster(max_read_transaction_life_versions=5, **TEST_KNOBS)
+        db = c.database()
+        c.commit_proxy.pump_interval = 10**9  # manual pumping only
+        seen = []
+        real_update = c.ratekeeper.update
+        c.ratekeeper.update = lambda storage_lag_versions=0: (
+            seen.append(storage_lag_versions),
+            real_update(storage_lag_versions),
+        )[1]
+        for i in range(20):
+            db.set(b"k%d" % i, b"v")
+        window = max(0, c.sequencer.committed_version - 5)
+        assert c.storage.durable_version < window  # backlog exists
+        c.commit_proxy._pump_durability(window)
+        assert seen and seen[-1] > 0
+        assert c.storage.durable_version == window  # pump flushed it
+        c.commit_proxy._pump_durability(window)
+        assert seen[-1] == 0  # caught up now
+
     def test_pop_respects_backup_hold(self):
         tlog = TLog()
         for v in range(1, 6):

@@ -107,3 +107,50 @@ def test_sharded_range_conflicts_cross_shard(mesh8):
     batches = [([w], 15, 0), (reads, 20, 0)]
     got = run_sharded(batches, mesh8)
     assert got[1] == [ck.CONFLICT] * 4
+
+
+def test_hybrid_host_chip_mesh_matches_flat(mesh8):
+    """A 2-D ('hosts','rs') mesh (the multi-host layout from
+    parallel/distributed.py, here on virtual devices) must produce the
+    same verdicts as the flat 8-shard mesh: the flattened coordinate is
+    the shard id and collectives reduce over both axes."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    hybrid = Mesh(devs, ("hosts", "rs"))
+    batches = make_point_batches(seed=5)
+    assert run_sharded(batches, hybrid) == run_sharded(batches, mesh8)
+
+
+def test_fleet_mesh_single_process(mesh8):
+    from foundationdb_tpu.parallel.distributed import fleet_mesh, initialize
+
+    idx, count = initialize()  # no coordinator configured -> no-op
+    assert idx == 0 and count == 1
+    m = fleet_mesh(8)
+    assert m.devices.size == 8 and m.axis_names == ("rs",)
+
+
+def test_resolve_many_matches_sequential(mesh8):
+    """One scanned dispatch over B batches == B single dispatches."""
+    import jax as _jax
+
+    params = SMALL
+    packer = BatchPacker(params)
+    batches = make_point_batches(seed=9, nbatches=8)
+    packed = [packer.pack(t, 0, cv, ws) for t, cv, ws in batches]
+
+    kern1 = ShardedResolverKernel(params, mesh=mesh8, donate=False)
+    want = []
+    for b, (txns, _, _) in zip(packed, batches):
+        status, _ = kern1.resolve(b)
+        want.append(np.asarray(status)[: len(txns)].tolist())
+
+    kern2 = ShardedResolverKernel(params, mesh=mesh8, donate=False)
+    stacked = _jax.tree.map(lambda *xs: np.stack(xs), *packed)
+    statuses = np.asarray(kern2.resolve_many(stacked))
+    got = [
+        statuses[i][: len(batches[i][0])].tolist() for i in range(len(batches))
+    ]
+    assert got == want
