@@ -38,13 +38,19 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     # decide from configuration alone — touching any device API first
     # (even process_count()) initializes the XLA backend, after which
     # jax.distributed.initialize refuses to run
-    if addr and jax._src.distributed.global_state.client is None:
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kw,
-        )
+    if addr:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kw,
+            )
+        except RuntimeError:
+            # already part of a process group (double-initialize), or the
+            # backend was touched first in a single-process run — both
+            # leave jax.process_* as the source of truth below
+            pass
     return jax.process_index(), jax.process_count()
 
 

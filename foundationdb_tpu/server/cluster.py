@@ -19,6 +19,7 @@ from foundationdb_tpu.server.datadistribution import DataDistributor
 from foundationdb_tpu.server.grv import GrvProxy
 from foundationdb_tpu.server.proxy import CommitProxy
 from foundationdb_tpu.server.ratekeeper import Ratekeeper
+from foundationdb_tpu.server.router import StorageRouter
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog
@@ -29,7 +30,7 @@ class Cluster:
     def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
                  version_clock="counter", storage_engines=None,
                  coordination=None, n_coordinators=3, coordination_dir=None,
-                 **knob_overrides):
+                 replication=None, **knob_overrides):
         if knobs is None:
             knobs = (
                 dataclasses.replace(DEFAULT_KNOBS, **knob_overrides)
@@ -89,11 +90,18 @@ class Cluster:
         self.resolvers = [
             Resolver(knobs, base_version=recovered) for _ in range(n_resolvers)
         ]
-        # v1 placement is full replication (every storage holds the whole
-        # keyspace); DD still accounts shard sizes + boundaries so splits
-        # and status are live, and partitioned placement can land on top.
-        self.dd = DataDistributor(self.storages, replication=n_storage)
+        # Placement: replication defaults to n_storage (every storage a
+        # full replica); replication < n_storage partitions the keyspace
+        # into shards owned by teams of that size, with the commit proxy
+        # routing writes and the StorageRouter stitching reads. The shard
+        # map itself is rebuilt at recovery (the WAL replays everywhere,
+        # so recovered storages open as full replicas until DD
+        # re-partitions); persisting the map in the system keyspace the
+        # way the reference's keyServers does is future work.
+        self.replication = replication or n_storage
+        self.dd = DataDistributor(self.storages, replication=self.replication)
         self._read_rr = itertools.count()  # round-robin read balancing
+        self.router = StorageRouter(self.storages, self.dd.map, self._read_rr)
         self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
         self.commit_proxy = CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
@@ -106,11 +114,10 @@ class Cluster:
         return self.storages[0]
 
     def read_storage(self, key=b""):
-        """Replica choice for a read (ref: fdbrpc/LoadBalance.actor.h —
-        the client spreads reads over the shard's team). The shard map
-        names the team; round-robin spreads load across its members."""
-        team = self.dd.map.team_for(key)
-        return self.storages[team[next(self._read_rr) % len(team)]]
+        """The read-side storage surface: the router resolves each read's
+        key (or range) to its shard's team and load-balances across the
+        replicas (ref: NativeAPI getKeyLocation + LoadBalance)."""
+        return self.router
 
     def rebalance(self):
         """One data-distribution round (splits/merges/moves)."""

@@ -24,12 +24,18 @@ class ShardMap:
     """Contiguous partition of the keyspace: boundaries[i] owns
     [boundaries[i], boundaries[i+1]). boundaries[0] is always b"".
 
+    Per-shard byte accounting lives here (not beside it) so splits and
+    merges — wherever they are invoked from — can never desync the
+    metadata from the boundaries.
+
     Ref: keyServers / shardBoundaries in the system keyspace.
     """
 
     def __init__(self, teams=None):
         self.boundaries = [b""]
         self.teams = [list(teams[0]) if teams else [0]]
+        self.sizes = [0]  # sampled bytes per shard
+        self.last_keys = [None]  # most recent write per shard
 
     def team_for(self, key):
         return self.teams[bisect.bisect_right(self.boundaries, key) - 1]
@@ -59,6 +65,10 @@ class ShardMap:
             raise ValueError(f"split point {at!r} outside shard [{b!r}, {e!r})")
         self.boundaries.insert(i + 1, at)
         self.teams.insert(i + 1, list(self.teams[i]))
+        half = self.sizes[i] // 2
+        self.sizes[i] -= half
+        self.sizes.insert(i + 1, half)
+        self.last_keys.insert(i + 1, self.last_keys[i])
 
     def merge(self, i):
         """Merge shard i+1 into shard i (teams must match)."""
@@ -68,6 +78,8 @@ class ShardMap:
             raise ValueError("cannot merge shards on different teams")
         del self.boundaries[i + 1]
         del self.teams[i + 1]
+        self.sizes[i] += self.sizes.pop(i + 1)
+        self.last_keys.pop(i + 1)
 
     def assign(self, i, team):
         self.teams[i] = list(team)
@@ -94,22 +106,19 @@ class DataDistributor:
         )
         self.max_shard_bytes = max_shard_bytes
         self.min_shard_bytes = min_shard_bytes
-        self._sizes = [0] * len(self.map)
-        # per-shard hottest-prefix sample for split points
-        self._last_key = [None] * len(self.map)
 
     def note_write(self, key, nbytes):
         i = self.map.shard_index(key)
-        self._sizes[i] += nbytes
-        self._last_key[i] = key
+        self.map.sizes[i] += nbytes
+        self.map.last_keys[i] = key
 
     def note_clear_range(self, begin, end):
         for i in self.map.shards_overlapping(begin, end):
-            self._sizes[i] = max(0, self._sizes[i] // 2)
+            self.map.sizes[i] = max(0, self.map.sizes[i] // 2)
 
     def team_bytes(self):
         out = [0] * len(self.storages)
-        for size, team in zip(self._sizes, self.map.teams):
+        for size, team in zip(self.map.sizes, self.map.teams):
             for s in team:
                 out[s] += size
         return out
@@ -125,16 +134,12 @@ class DataDistributor:
     def _split_large(self):
         i = 0
         while i < len(self.map):
-            if self._sizes[i] > self.max_shard_bytes:
+            if self.map.sizes[i] > self.max_shard_bytes:
                 at = self._split_point(i)
                 if at is not None:
                     self.map.split(i, at)
-                    half = self._sizes[i] // 2
-                    self._sizes[i] -= half
-                    self._sizes.insert(i + 1, half)
-                    self._last_key.insert(i + 1, self._last_key[i])
                     TraceEvent("DDShardSplit").detail(
-                        index=i, at=at, bytes=half * 2).log()
+                        index=i, at=at, bytes=self.map.sizes[i] * 2).log()
                     i += 1
             i += 1
 
@@ -152,15 +157,18 @@ class DataDistributor:
 
     # ── merges (ref: shardMerger) ──
     def _merge_small(self):
+        # hysteresis: whatever the configured floor, never merge two
+        # shards whose combined size would immediately re-trip the split
+        # threshold's neighborhood — otherwise one rebalance() round
+        # splits and the next line merges it back, forever
+        threshold = min(self.min_shard_bytes, self.max_shard_bytes // 4)
         i = 0
         while i + 1 < len(self.map):
             if (
-                self._sizes[i] + self._sizes[i + 1] < self.min_shard_bytes
+                self.map.sizes[i] + self.map.sizes[i + 1] < threshold
                 and self.map.teams[i] == self.map.teams[i + 1]
             ):
                 self.map.merge(i)
-                self._sizes[i] += self._sizes.pop(i + 1)
-                self._last_key.pop(i + 1)
             else:
                 i += 1
 
@@ -180,11 +188,11 @@ class DataDistributor:
             # balance (size < diff, else the move just flips the skew)
             cands = [
                 i for i, team in enumerate(self.map.teams)
-                if hot in team and cold not in team and self._sizes[i] < diff
+                if hot in team and cold not in team and self.map.sizes[i] < diff
             ]
             if not cands:
                 break
-            i = max(cands, key=self._sizes.__getitem__)
+            i = max(cands, key=self.map.sizes.__getitem__)
             old_team = list(self.map.teams[i])
             new_team = [cold if s == hot else s for s in old_team]
             self._relocate(i, old_team, new_team)
@@ -197,10 +205,15 @@ class DataDistributor:
         b, e = self.map.shard_range(i)
         src = self.storages[old_team[0]]
         joining = [s for s in new_team if s not in old_team]
-        for sid in joining:
-            dst = self.storages[sid]
-            rows = src.read_range(b, e, src.version, limit=None)
-            dst.ingest_shard(b, e, src.version, rows)
+        leaving = [s for s in old_team if s not in new_team]
+        if joining:
+            export = src.export_shard(b, e)  # one snapshot, k joiners
+            for sid in joining:
+                self.storages[sid].ingest_shard(b, e, export)
         self.map.assign(i, new_team)
+        for sid in leaving:
+            # wake watchers parked on the departing replica; they re-read
+            # and re-register via the router against the new owner
+            self.storages[sid].fire_watches_in_range(b, e)
         TraceEvent("DDRelocateShard").detail(
             begin=b, end=e, old=old_team, new=new_team).log()

@@ -205,7 +205,12 @@ class KeyValueStoreSQLite:
         self._conn.execute("INSERT OR REPLACE INTO kv VALUES (?, ?)", (key, value))
 
     def clear_range(self, begin, end):
-        self._conn.execute("DELETE FROM kv WHERE k >= ? AND k < ?", (begin, end))
+        if end is None:
+            self._conn.execute("DELETE FROM kv WHERE k >= ?", (begin,))
+        else:
+            self._conn.execute(
+                "DELETE FROM kv WHERE k >= ? AND k < ?", (begin, end)
+            )
 
     def commit(self, version):
         self._conn.execute(

@@ -104,9 +104,9 @@ class CommitProxy:
 
         # push even empty batches so storage's version advances with cv
         self.tlog.push(cv, batch_mutations)
-        for s in self.storages:
-            s.apply(cv, batch_mutations)
-            s.advance_window(window)
+        for sid, muts in enumerate(self._route(batch_mutations)):
+            self.storages[sid].apply(cv, muts)
+            self.storages[sid].advance_window(window)
         self.sequencer.report_committed(cv)
         if self.ratekeeper is not None:
             self.ratekeeper.observe_commit(len(requests), batch_conflicts)
@@ -132,6 +132,29 @@ class CommitProxy:
         self.tlog.pop(min(s.durable_version for s in self.storages))
         if self.ratekeeper is not None:
             self.ratekeeper.update(storage_lag_versions=lag)
+
+    def _route(self, mutations):
+        """Bucket mutations by owning storage in one pass (ref:
+        applyMetadataToCommittedTransactions tagging mutations with
+        storage tags via keyServers). Full replication (every storage on
+        every team) short-circuits to the identity. Clear-ranges go to
+        every storage whose shards overlap — applying the full range to
+        a partial owner is safe, it only clears keys actually held."""
+        n = len(self.storages)
+        if self.dd is None or self.dd.replication >= n:
+            return [mutations] * n
+        smap = self.dd.map
+        per = [[] for _ in range(n)]
+        for m in mutations:
+            if m.op == Op.CLEAR_RANGE:
+                owners = set()
+                for i in smap.shards_overlapping(m.key, m.param):
+                    owners.update(smap.teams[i])
+            else:
+                owners = smap.team_for(m.key)
+            for sid in owners:
+                per[sid].append(m)
+        return per
 
     def _resolve(self, txns, cv, window):
         if len(self.resolvers) == 1:

@@ -81,16 +81,22 @@ class Ratekeeper:
 
         # conflict trim: mostly-wasted work means admitting more txns only
         # manufactures retries; shed a third, recover gradually when healthy.
-        # Counters reset every round — a sub-threshold burst must not
-        # linger and trim some later, healthy period.
+        # Sub-threshold samples decay 25% per round instead of hard
+        # resetting: a sustained storm accumulates to the 100-txn sample
+        # even at low per-round volume (equilibrium 3x the per-round
+        # count), while a one-off burst fades within a few rounds and
+        # cannot trim a later, healthy period.
         target = min(lag_target, self.max_tps)
         total = self._recent_txns
         if total >= 100:
             ratio = self._recent_conflicts / total
             if ratio > self.CONFLICT_TRIM:
                 target = max(floor, min(target, self.target_tps * (2 / 3)))
-        self._recent_txns = 0
-        self._recent_conflicts = 0
+            self._recent_txns = 0
+            self._recent_conflicts = 0
+        else:
+            self._recent_txns = self._recent_txns * 3 // 4
+            self._recent_conflicts = self._recent_conflicts * 3 // 4
         if target > self.target_tps:
             # recover at most 10% per round so oscillation damps out
             target = min(target, max(self.target_tps * 1.1, floor))

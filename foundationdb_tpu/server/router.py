@@ -1,0 +1,68 @@
+"""Storage router: the client-side view of a partitioned storage tier.
+
+Ref parity: what NativeAPI's key-range → storage-server-interface cache
+plus LoadBalance do for the reference client (fdbclient/NativeAPI
+getKeyLocation / fdbrpc/LoadBalance.actor.h): every read names a key or
+range, the shard map names the owning team, and the request goes to one
+replica of that team — with range reads and key-selector walks stitched
+across shard boundaries in key order.
+
+The router exposes the same read surface as a single StorageServer —
+selector resolution and range reads come from the shared
+RangeReadInterface (storage.py) over a cross-shard merged iterator —
+so the transaction layer is placement-agnostic: full replication is
+just the one-shard case.
+"""
+
+from foundationdb_tpu.server.storage import RangeReadInterface
+
+
+class StorageRouter(RangeReadInterface):
+    def __init__(self, storages, shard_map, rr_counter):
+        self.storages = storages
+        self.map = shard_map
+        self._rr = rr_counter  # shared round-robin counter (cluster-owned)
+
+    def _pick(self, team):
+        """One replica of a team (ref: LoadBalance — spread reads)."""
+        return self.storages[team[next(self._rr) % len(team)]]
+
+    def storage_for(self, key):
+        return self._pick(self.map.team_for(key))
+
+    # ── single-storage invariants preserved across the tier ──
+    def _check_version(self, version):
+        self.storages[0]._check_version(version)
+
+    @property
+    def version(self):
+        return min(s.version for s in self.storages)
+
+    # ── point ops ──
+    def get(self, key, version):
+        return self.storage_for(key).get(key, version)
+
+    def watch(self, key, seen_value):
+        """Registered on the key's current owner. A shard relocation
+        fires affected watches spuriously (the mover's analog of the
+        reference erroring watches with wrong_shard_server), so watchers
+        re-read rather than hang on a storage that stopped receiving
+        the key's mutations."""
+        return self.storage_for(key).watch(key, seen_value)
+
+    # ── cross-shard merged iteration (feeds RangeReadInterface) ──
+    def _iter_live(self, begin, end, version, reverse=False):
+        idxs = self.map.shards_overlapping(begin, end)
+        if reverse:
+            idxs = list(reversed(idxs))
+        for i in idxs:
+            sb, se = self.map.shard_range(i)
+            b = max(begin, sb)
+            if end is None:
+                e = se
+            elif se is None:
+                e = end
+            else:
+                e = min(end, se)
+            storage = self._pick(self.map.teams[i])
+            yield from storage._iter_live(b, e, version, reverse=reverse)

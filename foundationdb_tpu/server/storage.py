@@ -48,7 +48,77 @@ class Watch:
                 cb()
 
 
-class StorageServer:
+
+class RangeReadInterface:
+    """Key-selector resolution and range reads over any provider of
+    ``_iter_live(begin, end, version, reverse)`` + ``_check_version``.
+
+    Shared by StorageServer (one storage's merged overlay/engine view)
+    and StorageRouter (the partitioned tier stitched across shards) so
+    selector semantics cannot diverge between them.
+    """
+
+    _WALK_END = b"\xff\xff"  # past every user + system key
+
+    def _live_keys(self, begin, end, version, reverse=False):
+        for k, _ in self._iter_live(begin, end, version, reverse=reverse):
+            yield k
+
+    def read_range(self, begin, end, version, limit=None):
+        """Plain (key, value) list over [begin, end) at ``version`` —
+        the shard-copy read used by data distribution (ref: fetchKeys'
+        getRange stream), bypassing key-selector resolution."""
+        self._check_version(version)
+        out = []
+        for kv in self._iter_live(begin, end, version):
+            out.append(kv)
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def resolve_selector(self, sel: KeySelector, version):
+        """Resolve a key selector to a concrete key (ref: storageserver
+        findKey): start at the last live key < (or <=) sel.key, then move
+        ``offset`` live keys right. Clamps to b'' / \\xff sentinel."""
+        import itertools
+
+        self._check_version(version)
+        offset = sel.offset
+        upper = sel.key + b"\x00" if sel.or_equal else sel.key
+        # lazily walk left from the reference key, taking only what the
+        # offset needs (the reference does the same bounded walk in findKey)
+        need = 1 if offset > 0 else (-offset + 1)
+        prev = list(
+            itertools.islice(self._live_keys(b"", upper, version, reverse=True), need)
+        )
+        if offset > 0:
+            start = prev[0] + b"\x00" if prev else b""
+            following = self._live_keys(start, self._WALK_END, version)
+            k = next(itertools.islice(following, offset - 1, None), None)
+            return k if k is not None else b"\xff"
+        else:
+            # offset 0 => last-less-than(-or-equal); negative walks left
+            idx = -offset
+            if idx < len(prev):
+                return prev[idx]
+            return b""
+
+    def get_range(self, begin_sel, end_sel, version, limit=0, reverse=False):
+        """Half-open range read by key selectors. Returns list[(k, v)]."""
+        self._check_version(version)
+        begin = begin_sel if isinstance(begin_sel, bytes) else self.resolve_selector(begin_sel, version)
+        end = end_sel if isinstance(end_sel, bytes) else self.resolve_selector(end_sel, version)
+        if begin > end:
+            return []
+        out = []
+        for kv in self._iter_live(begin, end, version, reverse=reverse):
+            out.append(kv)
+            if limit and len(out) >= limit:
+                break
+        return out
+
+
+class StorageServer(RangeReadInterface):
     def __init__(self, window_versions=5_000_000, engine=None):
         # overlay: key -> list[(version, value_or_None)] ascending, all
         # versions > durable_version; None = tombstone
@@ -221,75 +291,55 @@ class StorageServer:
                 yield kb
                 kb = next(base, sentinel)
 
-    def _live_keys(self, begin, end, version, reverse=False):
-        for k, _ in self._iter_live(begin, end, version, reverse=reverse):
-            yield k
+    def export_shard(self, begin, end):
+        """Snapshot a shard WITH its MVCC history: engine base rows at
+        the durable version plus every overlay version chain. Data
+        distribution hands this to joiners so reads at pre-move read
+        versions stay correct (ref: fetchKeys streaming + the mutation
+        buffer that brings a joining storage up to date)."""
+        base = dict(self.engine.iter_range(begin, end))
+        keys = set(base)
+        keys.update(self._overlay.irange(begin, end, inclusive=(True, False)))
+        rows = []
+        for k in sorted(keys):
+            chain = []
+            if k in base:
+                chain.append((self.durable_version, base[k]))
+            chain.extend(self._overlay.get(k, ()))
+            rows.append((k, chain))
+        return (self.oldest_version, self.version, rows)
 
-    def read_range(self, begin, end, version, limit=None):
-        """Plain (key, value) list over [begin, end) at ``version`` —
-        the shard-copy read used by data distribution (ref: fetchKeys'
-        getRange stream), bypassing key-selector resolution."""
-        self._check_version(version)
-        out = []
-        for kv in self._iter_live(begin, end, version):
-            out.append(kv)
-            if limit is not None and len(out) >= limit:
-                break
-        return out
-
-    def ingest_shard(self, begin, end, version, rows):
-        """Bulk-load a shard copied from another storage at ``version``
-        (ref: fetchKeys applying fetched blocks). Clears [begin, end)
-        first so deletes on the source do not survive on the joiner."""
-        if version > self.version:
-            # adopt the source's version for this server's frontier
-            self.version = version
-        self._apply_clear_range(begin, end, version)
-        for k, v in rows:
-            self._append(k, version, v)
-
-    def resolve_selector(self, sel: KeySelector, version):
-        """Resolve a key selector to a concrete key (ref: storageserver
-        findKey): start at the last live key < (or <=) sel.key, then move
-        ``offset`` live keys right. Clamps to b'' / \\xff sentinel."""
-        import itertools
-
-        self._check_version(version)
-        offset = sel.offset
-        upper = sel.key + b"\x00" if sel.or_equal else sel.key
-        # lazily walk left from the reference key, taking only what the
-        # offset needs (the reference does the same bounded walk in findKey)
-        need = 1 if offset > 0 else (-offset + 1)
-        prev = list(
-            itertools.islice(self._live_keys(b"", upper, version, reverse=True), need)
-        )
-        if offset > 0:
-            start = prev[0] + b"\x00" if prev else b""
-            following = self._live_keys(start, b"\xff\xff", version)
-            k = next(itertools.islice(following, offset - 1, None), None)
-            return k if k is not None else b"\xff"
-        else:
-            # offset 0 => last-less-than(-or-equal); negative walks left
-            idx = -offset
-            if idx < len(prev):
-                return prev[idx]
-            return b""
-
-    def get_range(self, begin_sel, end_sel, version, limit=0, reverse=False):
-        """Half-open range read by key selectors. Returns list[(k, v)]."""
-        self._check_version(version)
-        begin = begin_sel if isinstance(begin_sel, bytes) else self.resolve_selector(begin_sel, version)
-        end = end_sel if isinstance(end_sel, bytes) else self.resolve_selector(end_sel, version)
-        if begin > end:
-            return []
-        out = []
-        for kv in self._iter_live(begin, end, version, reverse=reverse):
-            out.append(kv)
-            if limit and len(out) >= limit:
-                break
-        return out
+    def ingest_shard(self, begin, end, export):
+        """Install an ``export_shard`` snapshot (ref: fetchKeys applying
+        fetched blocks). Physically clears [begin, end) first so stale
+        non-owned data and deletes on the source do not survive. The
+        read floor rises to the source's: versions below it were not
+        exported, and serving them here would silently miss history —
+        TOO_OLD (retryable) is the correct answer, exactly as a version
+        older than the window gets everywhere else."""
+        oldest, version, rows = export
+        self.version = max(self.version, version)
+        self.oldest_version = max(self.oldest_version, oldest)
+        self.engine.clear_range(begin, end)
+        for k in list(self._overlay.irange(begin, end, inclusive=(True, False))):
+            del self._overlay[k]
+        for k, chain in rows:
+            self._overlay[k] = list(chain)
+            for v, _ in chain:
+                self._dirty.append((v, k))
 
     # ───────────────────────────── watches ─────────────────────────────
+    def fire_watches_in_range(self, begin, end):
+        """Spuriously fire every watch on a key in [begin, end) — called
+        when a shard relocates away so watchers re-read from the new
+        owner instead of hanging on a storage that stopped receiving the
+        key's mutations (ref: watches erroring with wrong_shard_server
+        on shard moves; ours wakes instead of erroring)."""
+        for key in list(self._watches):
+            if begin <= key and (end is None or key < end):
+                for w in self._watches.pop(key):
+                    w._fire()
+
     def watch(self, key, seen_value):
         w = Watch(key, seen_value)
         current = self._lookup(key, self.version)

@@ -66,8 +66,8 @@ def test_merge_small_shards():
     storages = mk_storages(1)
     dd = DataDistributor(storages, min_shard_bytes=1000)
     dd.map.split(0, b"m")
-    dd._sizes = [10, 10]
-    dd._last_key = [None, None]
+    dd.map.sizes = [10, 10]
+    dd.map.last_keys = [None, None]
     dd.rebalance()
     assert len(dd.map) == 1
 
@@ -82,8 +82,8 @@ def test_rebalance_moves_to_cold_storage():
     # write real rows so relocation has data to copy
     storages[0].apply(1, [Mutation(Op.SET, b"a1", b"v1"),
                           Mutation(Op.SET, b"z1", b"v2")])
-    dd._sizes = [5000, 4000]
-    dd._last_key = [b"a1", b"z1"]
+    dd.map.sizes = [5000, 4000]
+    dd.map.last_keys = [b"a1", b"z1"]
     moves = dd.rebalance()
     assert moves, "imbalance of 9000 bytes must trigger a move"
     (rng, old, new), *_ = moves
@@ -113,7 +113,7 @@ def test_note_clear_range_decays_sizes():
     dd = DataDistributor(mk_storages(1))
     dd.note_write(b"a", 1000)
     dd.note_clear_range(b"", b"\xff")
-    assert dd._sizes[0] == 500
+    assert dd.map.sizes[0] == 500
 
 
 def test_cluster_read_storage_round_robins():
@@ -122,7 +122,7 @@ def test_cluster_read_storage_round_robins():
     from tests.conftest import TEST_KNOBS
 
     c = Cluster(n_storage=2, **TEST_KNOBS)
-    seen = {id(c.read_storage(b"k")) for _ in range(4)}
+    seen = {id(c.router.storage_for(b"k")) for _ in range(4)}
     assert len(seen) == 2  # both replicas serve reads
 
     # reads remain correct through the balancer

@@ -1,0 +1,166 @@
+"""Partitioned placement: shards owned by storage teams smaller than the
+storage fleet, write routing, cross-shard reads, and live shard moves.
+
+Models the reference's keyServers-driven placement: writes apply only to
+owning teams, reads stitch across shard boundaries through the router,
+and relocations keep everything readable.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.server.cluster import Cluster
+from tests.conftest import TEST_KNOBS
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(n_storage=4, replication=2, **TEST_KNOBS)
+    # carve the keyspace into 4 shards across distinct teams so routing
+    # is non-trivial from the start
+    m = c.dd.map
+    m.split(0, b"g")
+    m.split(1, b"n")
+    m.split(2, b"t")
+    m.assign(0, [0, 1])
+    m.assign(1, [1, 2])
+    m.assign(2, [2, 3])
+    m.assign(3, [3, 0])
+    return c
+
+
+KEYS = [b"alpha", b"golf", b"mike", b"november", b"tango", b"zulu"]
+
+
+def fill(db):
+    for k in KEYS:
+        db.set(k, b"v-" + k)
+
+
+def test_writes_apply_only_to_owning_team(cluster):
+    db = cluster.database()
+    fill(db)
+    m = cluster.dd.map
+    for k in KEYS:
+        team = m.team_for(k)
+        for sid, s in enumerate(cluster.storages):
+            held = s.get(k, s.version)
+            if sid in team:
+                assert held == b"v-" + k, (k, sid)
+            else:
+                assert held is None, (k, sid, "non-owner holds data")
+
+
+def test_point_reads_route(cluster):
+    db = cluster.database()
+    fill(db)
+    for k in KEYS:
+        assert db.get(k) == b"v-" + k
+    assert db.get(b"missing") is None
+
+
+def test_range_read_stitches_across_shards(cluster):
+    db = cluster.database()
+    fill(db)
+    assert [k for k, _ in db.get_range(b"", b"\xff")] == KEYS
+    # clipped + limited + reverse
+    assert [k for k, _ in db.get_range(b"g", b"u", limit=2)] == [b"golf", b"mike"]
+    rows = db.run(lambda tr: tr.get_range(b"", b"\xff", reverse=True, limit=3))
+    assert [k for k, _ in rows] == [b"zulu", b"tango", b"november"]
+
+
+def test_selectors_cross_shard_boundaries(cluster):
+    db = cluster.database()
+    fill(db)
+
+    def sel(tr):
+        # first key >= "h" is "mike" (next shard); +1 walks into "november"
+        k1 = tr.get_key(KeySelector.first_greater_or_equal(b"h"))
+        k2 = tr.get_key(KeySelector(b"h", False, 2))
+        # last key < "n" is "mike"; -1 more walks back into "golf"
+        k3 = tr.get_key(KeySelector.last_less_than(b"n"))
+        k4 = tr.get_key(KeySelector(b"n", False, -1))
+        return k1, k2, k3, k4
+
+    assert db.run(sel) == (b"mike", b"november", b"mike", b"golf")
+
+
+def test_clear_range_spans_shards(cluster):
+    db = cluster.database()
+    fill(db)
+    db.clear_range(b"g", b"u")  # hits shards 1, 2 and part of 3's range
+    assert [k for k, _ in db.get_range(b"", b"\xff")] == [b"alpha", b"zulu"]
+
+
+def test_occ_conflicts_still_detected(cluster):
+    from foundationdb_tpu.core.errors import FDBError
+
+    db = cluster.database()
+    fill(db)
+    t1, t2 = db.create_transaction(), db.create_transaction()
+    t1.get(b"tango"); t2.get(b"tango")
+    t1.set(b"tango", b"1"); t2.set(b"tango", b"2")
+    t1.commit()
+    with pytest.raises(FDBError) as ei:
+        t2.commit()
+    assert ei.value.code == 1020
+
+
+def test_relocation_keeps_reads_live_and_fires_watches(cluster):
+    db = cluster.database()
+    fill(db)
+    # park watches on both replicas of shard 1's team [1, 2] directly so
+    # the round-robin router cannot decide the test's outcome
+    w_leave = cluster.storages[1].watch(b"golf", b"v-golf")
+    w_stay = cluster.storages[2].watch(b"golf", b"v-golf")
+    # move shard 1 ([g, n), team [1,2]) to team [3, 2]: storage 1 leaves
+    cluster.dd._relocate(1, [1, 2], [3, 2])
+    assert w_leave.fired, "watch on the departing replica must wake"
+    assert not w_stay.fired, "surviving replica's watch stays armed"
+    assert db.get(b"golf") == b"v-golf"
+    assert db.get_range(b"g", b"n") == [(b"golf", b"v-golf"), (b"mike", b"v-mike")]
+    # writes now land on the new team — and fire the surviving watch
+    db.set(b"golf", b"v2")
+    assert cluster.storages[3].get(b"golf", cluster.storages[3].version) == b"v2"
+    assert w_stay.fired
+    assert db.get(b"golf") == b"v2"
+
+
+def test_relocation_preserves_mvcc_history(cluster):
+    """A transaction whose read version predates a shard move must still
+    read the values as of its snapshot from the NEW owner (export/ingest
+    carries version chains, not just latest values)."""
+    db = cluster.database()
+    fill(db)
+    tr = db.create_transaction()
+    rv = tr.get_read_version()  # snapshot BEFORE the move + overwrite
+    db.set(b"golf", b"v-newer")  # version > rv on the old team
+    cluster.dd._relocate(1, [1, 2], [3, 2])
+    # the snapshot read routes to the new owner and must see the OLD value
+    assert tr.get(b"golf", snapshot=True) == b"v-golf"
+    assert db.get(b"golf") == b"v-newer"
+    # ranges at the old snapshot too
+    assert dict(tr.get_range(b"g", b"n", snapshot=True))[b"golf"] == b"v-golf"
+
+
+def test_atomic_ops_route(cluster):
+    db = cluster.database()
+    db.add(b"golf", (5).to_bytes(8, "little"))
+    db.add(b"golf", (7).to_bytes(8, "little"))
+    assert int.from_bytes(db.get(b"golf"), "little") == 12
+
+
+def test_backup_restore_partitioned(cluster, tmp_path):
+    from foundationdb_tpu.tools.backup import BackupAgent, restore
+
+    db = cluster.database()
+    fill(db)
+    agent = BackupAgent(db, str(tmp_path / "bk"))
+    agent.snapshot()
+    db.set(b"post", b"snap")
+    agent.pull_log()
+    db2 = Cluster(n_storage=2, replication=1, **TEST_KNOBS).database()
+    restore(db2, str(tmp_path / "bk"))
+    for k in KEYS:
+        assert db2.get(k) == b"v-" + k
+    assert db2.get(b"post") == b"snap"
