@@ -10,6 +10,8 @@ interleave other actors or inject faults.
 import struct
 import zlib
 
+from foundationdb_tpu.core.mutations import Op, apply_atomic
+
 from foundationdb_tpu.core.errors import FDBError
 
 
@@ -194,6 +196,161 @@ def serializability_check(db, log, n_keys, prefix=b"ser/"):
         assert final.get(k) == v, f"final state diverges at {k!r}"
     for k in [key(i) for i in range(n_keys)]:
         assert final.get(k) == oracle.get(k), f"final state diverges at {k!r}"
+
+
+# ──────────────────────── api correctness ──────────────────────────────
+class ApiModel:
+    """In-memory model of one actor's keyspace slice (ref: the
+    MemoryKeyValueStore ApiCorrectness compares against)."""
+
+    def __init__(self):
+        self.data = {}  # committed state
+
+    def snapshot(self):
+        return dict(self.data)
+
+
+def api_correctness_workload(db, model, n_txns, n_keys, rng,
+                             prefix=b"api/"):
+    """Randomized API transactions checked op-by-op against a model.
+
+    Each transaction interleaves mutations (set / clear / clear_range /
+    atomic add) with reads (get, get_range with limit/reverse), and every
+    read is asserted against the model's view folded with the txn's own
+    staged writes — read-your-writes, range merge, and atomic folding are
+    all checked in-flight, then the committed state is folded into the
+    model. commit_unknown_result disambiguates via a receipt key the
+    actor alone writes. The actor owns ``prefix`` exclusively, so the
+    model is exact even under fault injection.
+    """
+    key = lambda i: prefix + b"k%03d" % i
+    receipt_key = prefix + b"receipt"
+
+    for t in range(n_txns):
+        token = b"t%d" % t
+        script = [rng.randrange(7) for _ in range(rng.randrange(2, 8))]
+        cell = {}  # staged view of the most recent attempt (for 1021)
+
+        def fn(tr, script=script, token=token, cell=cell):
+            staged = model.snapshot()
+            cell["staged"] = staged
+
+            def fold_add(k, param):
+                staged[k] = apply_atomic(Op.ADD, staged.get(k), param)
+
+            for op in script:
+                if op == 0:  # set
+                    k, v = key(rng.randrange(n_keys)), b"v%d" % rng.randrange(999)
+                    tr.set(k, v)
+                    staged[k] = v
+                elif op == 1:  # clear
+                    k = key(rng.randrange(n_keys))
+                    tr.clear(k)
+                    staged.pop(k, None)
+                elif op == 2:  # clear_range
+                    a, b = sorted(rng.sample(range(n_keys), 2))
+                    tr.clear_range(key(a), key(b))
+                    for i in range(a, b):
+                        staged.pop(key(i), None)
+                elif op == 3:  # atomic add
+                    k = key(rng.randrange(n_keys))
+                    param = struct.pack("<q", rng.randrange(-5, 10))
+                    tr.add(k, param)
+                    fold_add(k, param)
+                elif op == 4:  # get (RYW check)
+                    k = key(rng.randrange(n_keys))
+                    assert tr.get(k) == staged.get(k), (
+                        f"get({k!r}) diverged from model")
+                elif op == 5:  # get_range with limit
+                    a, b = sorted(rng.sample(range(n_keys + 1), 2))
+                    limit = rng.randrange(1, 6)
+                    got = tr.get_range(key(a), key(b), limit=limit)
+                    want = sorted(
+                        (k, v) for k, v in staged.items()
+                        if key(a) <= k < key(b)
+                    )[:limit]
+                    assert got == want, f"get_range diverged: {got} != {want}"
+                else:  # reverse range
+                    a, b = sorted(rng.sample(range(n_keys + 1), 2))
+                    got = tr.get_range(key(a), key(b), reverse=True, limit=3)
+                    want = sorted(
+                        ((k, v) for k, v in staged.items()
+                         if key(a) <= k < key(b)),
+                        reverse=True,
+                    )[:3]
+                    assert got == want, "reverse get_range diverged"
+            tr.set(receipt_key, token)
+            return staged
+
+        outcome, staged, _tr = yield from run_txn(db, fn)
+        if outcome == "unknown":
+            check = yield from run_txn(db, lambda tr: tr.get(receipt_key))
+            if check[0] == "unknown" or check[1] != token:
+                continue  # did not commit; model unchanged
+            # a 1021 always comes from the FINAL attempt (run_txn returns
+            # on the first one), so the ambiguous-but-committed state is
+            # exactly the staged view that attempt recorded
+            staged = cell["staged"]
+        model.data = {k: v for k, v in staged.items()}
+        model.data[receipt_key] = token
+
+
+def api_correctness_check(db, model, prefix=b"api/"):
+    """Final state must equal the model exactly."""
+    final = dict(db.get_range(prefix, prefix + b"\xff"))
+    assert final == model.data, (
+        f"final state diverged: extra={set(final) - set(model.data)} "
+        f"missing={set(model.data) - set(final)} "
+        f"changed={[k for k in final if k in model.data and final[k] != model.data[k]]}"
+    )
+
+
+# ─────────────────────────── mako load mix ──────────────────────────────
+def mako_workload(db, n_txns, n_rows, rng, stats, mix=None, prefix=b"mako/"):
+    """Mixed-operation load generator (ref: bindings' mako benchmark
+    tool): each transaction performs GRV + a configurable mix of
+    get / set / getrange / update (read-modify-write) / clearrange ops
+    over a fixed row population. ``stats`` accrues per-op counts; the
+    sanity check is that the row population's key set never changes
+    (updates overwrite, clears are immediately refilled)."""
+    mix = mix or {"get": 4, "set": 2, "getrange": 2, "update": 1, "clearrange": 1}
+    ops = [op for op, w in mix.items() for _ in range(w)]
+    row = lambda i: prefix + b"r%06d" % i
+
+    for _ in range(n_txns):
+        chosen = [rng.choice(ops) for _ in range(rng.randrange(1, 5))]
+
+        def fn(tr, chosen=chosen):
+            for op in chosen:
+                i = rng.randrange(n_rows)
+                if op == "get":
+                    tr.get(row(i))
+                elif op == "set":
+                    tr.set(row(i), b"x" * rng.randrange(8, 32))
+                elif op == "getrange":
+                    tr.get_range(row(i), row(min(i + 10, n_rows)), limit=10)
+                elif op == "update":
+                    v = tr.get(row(i)) or b""
+                    tr.set(row(i), v[:16] + b"u")
+                else:  # clearrange + refill, population invariant kept
+                    j = min(i + rng.randrange(1, 4), n_rows)
+                    tr.clear_range(row(i), row(j))
+                    for k in range(i, j):
+                        tr.set(row(k), b"refill")
+                stats[op] = stats.get(op, 0) + 1
+
+        outcome, _, _tr = yield from run_txn(db, fn)
+        stats["txns"] = stats.get("txns", 0) + 1
+        if outcome == "unknown":
+            stats["unknown"] = stats.get("unknown", 0) + 1
+
+
+def mako_check(db, n_rows, prefix=b"mako/"):
+    """Row population invariant: exactly n_rows keys, none missing."""
+    rows = db.get_range(prefix, prefix + b"\xff")
+    assert len(rows) == n_rows, f"population changed: {len(rows)} != {n_rows}"
+    for i, (k, _) in enumerate(rows):
+        assert k == prefix + b"r%06d" % i
 
 
 # ───────────────────────────── atomic ops ───────────────────────────────

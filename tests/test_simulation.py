@@ -134,3 +134,54 @@ def test_buggify_site_gating():
     for s in reversed(sites):  # different first-evaluation order
         b2(s)
     assert {s: b1._sites[s] for s in sites} == {s: b2._sites[s] for s in sites}
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_api_correctness_under_faults(seed, tmp_path):
+    """Randomized API transactions checked op-by-op against a model,
+    under buggify faults + crash recovery (ref: ApiCorrectness)."""
+    from foundationdb_tpu.sim.workloads import (
+        ApiModel, api_correctness_check, api_correctness_workload,
+    )
+
+    sim = Simulation(seed=seed, crash_p=0.003,
+                     datadir=str(tmp_path / "api"))
+    models = []
+    for a in range(3):
+        model = ApiModel()
+        models.append(model)
+        rng = random.Random(seed * 77 + a)
+        sim.add_workload(
+            f"api{a}",
+            api_correctness_workload(
+                sim.db, model, n_txns=25, n_keys=24, rng=rng,
+                prefix=b"api/%d/" % a,
+            ),
+        )
+    sim.run()
+    sim.quiesce()
+    for a, model in enumerate(models):
+        api_correctness_check(sim.db, model, prefix=b"api/%d/" % a)
+    sim.close()
+
+
+def test_mako_load_mix_under_faults(tmp_path):
+    """Mixed-op load generator keeps the row population intact under
+    faults (ref: the mako benchmark tool's workload shape)."""
+    from foundationdb_tpu.sim.workloads import mako_check, mako_workload
+
+    sim = Simulation(seed=31, crash_p=0.002, datadir=str(tmp_path / "mako"))
+    n_rows = 40
+    sim.db.run(lambda tr: [tr.set(b"mako/r%06d" % i, b"seed") for i in range(n_rows)])
+    stats = {}
+    for a in range(3):
+        rng = random.Random(31 * 13 + a)
+        sim.add_workload(
+            f"mako{a}", mako_workload(sim.db, 25, n_rows, rng, stats)
+        )
+    sim.run()
+    sim.quiesce()
+    mako_check(sim.db, n_rows)
+    assert stats["txns"] == 75
+    assert {"get", "set", "getrange", "update", "clearrange"} <= set(stats)
+    sim.close()
