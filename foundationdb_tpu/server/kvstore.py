@@ -238,3 +238,217 @@ def open_engine(kind, path=None, **kw):
             raise ValueError("sqlite engine requires a path")
         return KeyValueStoreSQLite(path, **kw)
     raise ValueError(f"unknown storage engine {kind!r}")
+
+
+class KeyValueStoreVersioned:
+    """Versioned durable store — the Redwood-role engine.
+
+    Ref parity: fdbserver/VersionedBTree.actor.cpp (Redwood) — the
+    reference's flagship engine stores MULTIPLE versions per key in a
+    copy-on-write B-tree, so the storage server's MVCC window can extend
+    into the durable tier instead of ending at the in-memory overlay.
+    Ours keeps the same contract with a different shape (no point
+    translating a paged COW tree into Python): per-key version chains in
+    an ordered map, an append-only WAL with snapshot compaction for
+    durability, and ``prune()`` garbage-collecting history that left the
+    retention window.
+
+    The storage server detects ``versioned = True`` and (a) flushes every
+    overlay version down instead of folding to the newest, (b) serves
+    reads below the durable version from ``get_at`` / ``iter_range_at``,
+    and (c) stops force-advancing its read floor at flush time.
+    """
+
+    versioned = True
+
+    def __init__(self, path=None, fsync=False, snapshot_every_ops=50_000):
+        # key -> [(version, value|None), ...] ascending; None = tombstone
+        self._chains = SortedDict()
+        self._version = 0
+        self._oldest = 0  # oldest version with full history retained
+        self.path = path
+        self.fsync = fsync
+        self._ops_since_snapshot = 0
+        self._snapshot_every = snapshot_every_ops
+        self._wal = None
+        if path is not None:
+            self._recover()
+            self._wal = open(self._wal_path, "ab")
+
+    @property
+    def _snap_path(self):
+        return self.path + ".snap"
+
+    @property
+    def _wal_path(self):
+        return self.path + ".oplog"
+
+    # ── versioned reads ──
+    @staticmethod
+    def _at(chain, version):
+        """Newest value at-or-below ``version`` (None = absent/tombstone)."""
+        val = None
+        for v, x in chain:
+            if v <= version:
+                val = x
+            else:
+                break
+        return val
+
+    def get_at(self, key, version):
+        chain = self._chains.get(key)
+        return self._at(chain, version) if chain else None
+
+    def iter_range_at(self, begin, end, version, reverse=False):
+        for k in self._chains.irange(begin, end, inclusive=(True, False),
+                                     reverse=reverse):
+            val = self._at(self._chains[k], version)
+            if val is not None:
+                yield k, val
+
+    # ── single-version facade (durable view — engine interface compat) ──
+    def get(self, key):
+        return self.get_at(key, self._version)
+
+    def iter_range(self, begin, end, reverse=False):
+        yield from self.iter_range_at(begin, end, self._version, reverse=reverse)
+
+    def get_range(self, begin, end, limit=0, reverse=False):
+        out = []
+        for kv in self.iter_range(begin, end, reverse=reverse):
+            out.append(kv)
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def stored_version(self):
+        return self._version
+
+    @property
+    def oldest_retained(self):
+        return self._oldest
+
+    def __len__(self):
+        return sum(1 for _ in self.iter_range(b"", None))
+
+    # ── writes ──
+    def set_versioned(self, key, version, value):
+        """Record ``value`` (None = tombstone) for key at version.
+        Versions per key arrive ascending (flush order)."""
+        chain = self._chains.get(key)
+        if chain is None:
+            chain = []
+            self._chains[key] = chain
+        if chain and chain[-1][0] == version:
+            chain[-1] = (version, value)
+        else:
+            chain.append((version, value))
+        self._log(("sv", key, (version, value)))
+
+    def set(self, key, value):
+        # single-version compat (restore paths); records at the current
+        # durable version
+        self.set_versioned(key, self._version, value)
+
+    def clear_range(self, begin, end):
+        for k in list(self._chains.irange(begin, end, inclusive=(True, False))):
+            if self._at(self._chains[k], self._version) is not None:
+                self.set_versioned(k, self._version, None)
+
+    def commit(self, version):
+        self._version = max(self._version, version)
+        self._log(("v", version, None))
+        if self._wal is not None:
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            if self._ops_since_snapshot >= self._snapshot_every:
+                self.compact()
+
+    def prune(self, before_version):
+        """Drop history below ``before_version``: each chain keeps its
+        newest entry at-or-below it (the base any admissible read needs)
+        and everything newer (ref: Redwood trimming old page versions)."""
+        if before_version <= self._oldest:
+            return
+        dead = []
+        for k, chain in self._chains.items():
+            base_idx = -1
+            for i, (v, _) in enumerate(chain):
+                if v <= before_version:
+                    base_idx = i
+                else:
+                    break
+            if base_idx > 0:
+                del chain[:base_idx]
+            # a tombstone base below the horizon can drop entirely
+            if len(chain) == 1 and chain[0][0] <= before_version and chain[0][1] is None:
+                dead.append(k)
+        for k in dead:
+            del self._chains[k]
+        self._oldest = before_version
+        self._log(("p", before_version, None))
+
+    # ── durability plumbing (same framing as KeyValueStoreMemory) ──
+    def _log(self, op):
+        if self._wal is None:
+            return
+        payload = pickle.dumps(op, protocol=4)
+        self._wal.write(struct.pack(">II", len(payload), zlib.crc32(payload)) + payload)
+        self._ops_since_snapshot += 1
+
+    def compact(self):
+        if self.path is None:
+            return
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                (self._version, self._oldest, dict(self._chains)), f, protocol=4
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+        self._ops_since_snapshot = 0
+
+    def _recover(self):
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                self._version, self._oldest, chains = pickle.load(f)
+            self._chains = SortedDict(
+                {k: list(c) for k, c in chains.items()}
+            )
+        try:
+            with open(self._wal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        off = 0
+        while off + 8 <= len(raw):
+            ln, crc = struct.unpack_from(">II", raw, off)
+            if off + 8 + ln > len(raw):
+                break  # torn tail
+            payload = raw[off + 8 : off + 8 + ln]
+            if zlib.crc32(payload) != crc:
+                break
+            kind, a, b = pickle.loads(payload)
+            if kind == "sv":
+                version, value = b
+                chain = self._chains.setdefault(a, [])
+                if chain and chain[-1][0] == version:
+                    chain[-1] = (version, value)
+                else:
+                    chain.append((version, value))
+            elif kind == "v":
+                self._version = max(self._version, a)
+            elif kind == "p":
+                self.prune(a)  # _wal is still None here: no re-logging
+            off += 8 + ln
+
+    def close(self):
+        if self._wal is not None:
+            self._wal.flush()
+            self._wal.close()
+            self._wal = None
