@@ -30,9 +30,10 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _build():
+def _compile(src, so, extra_flags=()):
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC,
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        *extra_flags, "-o", so, src,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -40,6 +41,10 @@ def _build():
         raise NativeBuildError("g++ not available") from e
     except subprocess.CalledProcessError as e:
         raise NativeBuildError(f"native build failed:\n{e.stderr}") from e
+
+
+def _build():
+    _compile(_SRC, _SO)
 
 
 def load_library():
@@ -87,6 +92,62 @@ def native_available():
         return True
     except (NativeBuildError, OSError):
         return False
+
+
+_PACKER_SRC = os.path.join(_HERE, "packer.cpp")
+_PACKER_SO = os.path.join(_HERE, "fdbtpu_packer.so")
+_packer_mod = None
+_packer_failed = False
+
+
+def _build_packer():
+    import sys
+    import sysconfig
+
+    flags = [f"-I{sysconfig.get_paths()['include']}"]
+    if sys.platform == "darwin":
+        # CPython extensions resolve Python symbols at load time on mac
+        flags += ["-undefined", "dynamic_lookup"]
+    _compile(_PACKER_SRC, _PACKER_SO, flags)
+
+
+def _import_packer():
+    from importlib.machinery import ExtensionFileLoader
+    from importlib.util import module_from_spec, spec_from_loader
+
+    loader = ExtensionFileLoader("fdbtpu_packer", _PACKER_SO)
+    spec = spec_from_loader("fdbtpu_packer", loader)
+    mod = module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def load_packer():
+    """Build (if stale) and import the CPython packer extension; returns
+    the module or None when a native toolchain isn't available (callers
+    fall back to the numpy packer)."""
+    global _packer_mod, _packer_failed
+    with _lock:
+        if _packer_mod is not None or _packer_failed:
+            return _packer_mod
+        try:
+            if (
+                not os.path.exists(_PACKER_SO)
+                or os.path.getmtime(_PACKER_SO) < os.path.getmtime(_PACKER_SRC)
+            ):
+                _build_packer()
+            try:
+                _packer_mod = _import_packer()
+            except ImportError:
+                # stale/foreign-arch artifact (same hazard load_library
+                # handles): rebuild from source and retry once
+                os.unlink(_PACKER_SO)
+                _build_packer()
+                _packer_mod = _import_packer()
+        except (NativeBuildError, ImportError, OSError):
+            _packer_failed = True
+            _packer_mod = None
+        return _packer_mod
 
 
 _STATUS_MAP = {0: COMMITTED, 1: CONFLICT, 2: TOO_OLD}
