@@ -267,6 +267,107 @@ def measure_kernel_step_ms(ck, params, batch, n=30):
     return (time.perf_counter() - t0) / n * 1e3
 
 
+def run_e2e(cpu):
+    """End-to-end committed txns/sec: N client threads driving pipelined
+    commits through the full live pipeline — Transaction → batching
+    commit proxy (shared-version batches) → TPU resolver → tlog →
+    storage apply. The client model is W in-flight async commits per
+    thread (each thread stands in for W concurrent clients), which is
+    what fills the resolver's batch lanes the way the reference's
+    commitBatcher does across real client connections.
+
+    Workload: YCSB-A-shaped on 'user%08d' keys — 50% blind updates, 50%
+    read-modify-write (the read adds a real read-conflict range, so the
+    resolver does real OCC work and RMW txns can genuinely conflict).
+    """
+    import threading
+
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.server.cluster import Cluster
+
+    env = os.environ.get
+    clients = int(env("BENCH_E2E_CLIENTS", 8))
+    window = int(env("BENCH_E2E_WINDOW", 128 if not cpu else 32))
+    seconds = float(env("BENCH_E2E_SECONDS", 8 if not cpu else 3))
+    nkeys = int(env("BENCH_E2E_KEYS", 100_000 if not cpu else 10_000))
+    cluster = Cluster(
+        commit_pipeline="thread",
+        resolver_backend="tpu",
+        batch_txn_capacity=1024 if not cpu else 128,
+        hash_table_bits=20 if not cpu else 15,
+        range_ring_capacity=4096 if not cpu else 256,
+        commit_batch_max=1024 if not cpu else 128,
+    )
+    db = cluster.database()
+    # warm the pipeline (first batch jit-compiles the resolver kernel,
+    # tens of seconds on CPU) before the measured window opens
+    warm = db.create_transaction()
+    warm.set(b"warmup", b"x")
+    warm.commit()
+    stop = threading.Event()
+    committed = [0] * clients
+    conflicts = [0] * clients
+    errors = []
+
+    def client(cid):
+        rng = np.random.default_rng(1000 + cid)
+        ids = rng.integers(0, nkeys, size=16384)
+        is_rmw = rng.random(16384) < 0.5
+        val = b"x" * 100
+        j = 0
+        try:
+            while not stop.is_set():
+                trs, futs = [], []
+                for _ in range(window):
+                    tr = db.create_transaction()
+                    k = b"user%08d" % ids[j % 16384]
+                    if is_rmw[j % 16384]:
+                        tr.get(k)  # adds a read-conflict range: real OCC
+                    tr.set(k, val)
+                    j += 1
+                    trs.append(tr)
+                    futs.append(tr.commit_async())
+                for tr, fut in zip(trs, futs):
+                    fut.result(timeout=60)
+                    try:
+                        tr.commit_finish(fut)
+                        committed[cid] += 1
+                    except FDBError as e:
+                        if e.code in (1020, 1021):
+                            conflicts[cid] += 1
+                        else:
+                            raise
+        except Exception as e:  # surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(c,), daemon=True)
+        for c in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    elapsed = time.perf_counter() - t0
+    cluster.commit_proxy.close()
+    if errors:
+        raise errors[0]
+    bp = cluster.commit_proxy
+    total = sum(committed)
+    return {
+        "e2e_committed_txns_per_sec": round(total / elapsed, 1),
+        "e2e_clients": clients * window,
+        "e2e_mean_batch": round(bp.txns_batched / max(bp.batches_committed, 1), 1),
+        "e2e_max_batch": bp.max_batch_seen,
+        "e2e_conflict_rate": round(
+            sum(conflicts) / max(total + sum(conflicts), 1), 4
+        ),
+    }
+
+
 def main():
     watchdog_finish = _start_watchdog()
     platform, fallback_note = _init_platform()
@@ -379,6 +480,10 @@ def main():
     }
     if fallback_note is not None:
         out["fallback_from"] = fallback_note[:200]
+    # end-to-end pipeline number alongside the kernel-only number (point
+    # mode only; BENCH_E2E=0 skips)
+    if point and env("BENCH_E2E", "1") != "0":
+        out.update(run_e2e(cpu))
     watchdog_finish()
     print(json.dumps(out))
 

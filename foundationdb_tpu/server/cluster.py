@@ -30,7 +30,9 @@ class Cluster:
     def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
                  version_clock="counter", storage_engines=None,
                  coordination=None, n_coordinators=3, coordination_dir=None,
-                 replication=None, **knob_overrides):
+                 replication=None, commit_pipeline="sync",
+                 commit_batch_max=None, commit_flush_after=4,
+                 **knob_overrides):
         if knobs is None:
             knobs = (
                 dataclasses.replace(DEFAULT_KNOBS, **knob_overrides)
@@ -107,6 +109,19 @@ class Cluster:
             self.sequencer, self.resolvers, self.tlog, self.storages,
             knobs, self.ratekeeper, dd=self.dd,
         )
+        # ── cross-client batching (ref: CommitProxyServer commitBatcher) ──
+        # "thread": a daemon batcher collects concurrent commits into
+        # shared-version batches (live deployments / e2e bench).
+        # "manual": deterministic batching driven by the sim scheduler.
+        # "sync": 1-txn batches, the degenerate pipeline.
+        self.commit_pipeline = commit_pipeline
+        if commit_pipeline != "sync":
+            from foundationdb_tpu.server.batcher import BatchingCommitProxy
+
+            self.commit_proxy = BatchingCommitProxy(
+                self.commit_proxy, max_batch=commit_batch_max,
+                flush_after=commit_flush_after, mode=commit_pipeline,
+            )
 
     # v1: single storage team holding the whole keyspace; reads go to [0].
     @property

@@ -64,8 +64,10 @@ class CommitProxy:
         txns = [
             TxnRequest(
                 read_version=r.read_version,
-                range_reads=list(r.read_conflict_ranges),
-                range_writes=list(r.write_conflict_ranges),
+                point_reads=_points(r.read_conflict_ranges),
+                point_writes=_points(r.write_conflict_ranges),
+                range_reads=_true_ranges(r.read_conflict_ranges),
+                range_writes=_true_ranges(r.write_conflict_ranges),
             )
             for r in requests
         ]
@@ -173,6 +175,8 @@ class CommitProxy:
                 shard_txns.append(
                     TxnRequest(
                         read_version=t.read_version,
+                        point_reads=_clip_points(t.point_reads, lo, hi),
+                        point_writes=_clip_points(t.point_writes, lo, hi),
                         range_reads=_clip(t.range_reads, lo, hi),
                         range_writes=_clip(t.range_writes, lo, hi),
                     )
@@ -197,6 +201,24 @@ class CommitProxy:
         lo = bytes([256 * i // n]) if i else b""
         hi = bytes([256 * (i + 1) // n]) if i + 1 < n else None
         return lo, hi
+
+
+def _points(ranges):
+    """Single-key conflict ranges [k, k+\\x00) routed to the resolver's
+    point lanes — O(1) hash-table checks on device instead of the range
+    lanes' ring scans. The reference makes the same point/range
+    distinction inside detectConflicts (SkipList point queries vs range
+    walks); semantics are identical either way (a point op IS the tiny
+    range), this is purely the fast path."""
+    return [b for b, e in ranges if e == b + b"\x00"]
+
+
+def _true_ranges(ranges):
+    return [(b, e) for b, e in ranges if e != b + b"\x00"]
+
+
+def _clip_points(keys, lo, hi):
+    return [k for k in keys if k >= lo and (hi is None or k < hi)]
 
 
 def _clip(ranges, lo, hi):

@@ -50,6 +50,34 @@ class FaultyCommitProxy:
             return err("commit_unknown_result")
         return result
 
+    def submit(self, request):
+        """Async path (BatchingCommitProxy): same two fault sites."""
+        if self._buggify("commit_dropped"):
+            from foundationdb_tpu.server.batcher import CommitFuture
+
+            fut = CommitFuture()
+            fut.set(err("commit_unknown_result"))
+            return fut
+        fut = self._inner.submit(request)
+        if self._buggify("commit_applied_then_unknown"):
+            return _UnknownResultFuture(fut)
+        return fut
+
+
+class _UnknownResultFuture:
+    """The batch committed (or will), but the reply was lost: the client
+    sees commit_unknown_result either way — legal 1021 behavior."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def done(self):
+        return self._inner.done()
+
+    def result(self, timeout=None):
+        self._inner.result(timeout)  # propagate resolution ordering
+        return err("commit_unknown_result")
+
 
 class FaultyGrvProxy:
     def __init__(self, inner, buggify):
@@ -117,6 +145,12 @@ class Simulation:
         """Kill the cluster (losing all volatile state) and restart from
         the engine snapshot + WAL. In-flight transactions keep their old
         read versions and get fenced by the recovered resolver window."""
+        if hasattr(self.cluster.commit_proxy, "fail_pending"):
+            # queued-but-unbatched commits die with the proxy: clients
+            # must see 1021, never hang on an orphaned future
+            self.cluster.commit_proxy.fail_pending(
+                err("commit_unknown_result")
+            )
         self.cluster.storage.engine.close()
         self.cluster.tlog.close()
         old_db = self.db
@@ -148,10 +182,17 @@ class Simulation:
                 next(gen)
             except StopIteration:
                 live.pop(i)
+            # manual-mode batching: the scheduler is the batch clock
+            # (deterministic analog of the proxy's commit interval)
+            cp = self.cluster.commit_proxy
+            if hasattr(cp, "pump"):
+                cp.pump(self.steps)
         self._actors = []
 
     def quiesce(self):
         """Flush storage so everything is durable (end-of-run barrier)."""
+        if hasattr(self.cluster.commit_proxy, "flush"):
+            self.cluster.commit_proxy.flush()
         self.cluster.storage.flush()
 
     def close(self):

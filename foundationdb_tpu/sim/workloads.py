@@ -105,6 +105,37 @@ def slow_cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
             # retryable: abandon the attempt, new transaction
 
 
+def batched_cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
+    """Cycle txns committed through the *async* path: the actor submits
+    to the batching commit proxy and yields until the shared-version
+    batch resolves. Many such actors running concurrently are what fills
+    the TPU resolver's batch lanes — the live-system analog of the
+    reference's commitBatcher accumulating commits from many clients."""
+    key = lambda i: prefix + _enc(i)
+    ops = 0
+    while ops < n_ops:
+        tr = db.create_transaction()
+        try:
+            yield
+            r = rng.randrange(n_nodes)
+            a = _dec(tr.get(key(r)))
+            b = _dec(tr.get(key(a)))
+            c = _dec(tr.get(key(b)))
+            tr.set(key(r), _enc(b))
+            tr.set(key(a), _enc(c))
+            tr.set(key(b), _enc(a))
+            fut = tr.commit_async()
+            while not fut.done():
+                yield  # the scheduler's pump() forms the batch
+            tr.commit_finish(fut)
+            ops += 1
+        except FDBError as e:
+            if e.code == 1021:
+                ops += 1  # either way the cycle invariant holds
+            elif not e.is_retryable:
+                raise
+
+
 def cycle_check(db, n_nodes, prefix=b"cycle/"):
     """The walk from node 0 must traverse all nodes and close."""
     rows = dict(db.get_range(prefix, prefix + b"\xff"))

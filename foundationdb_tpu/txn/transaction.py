@@ -381,22 +381,17 @@ class Transaction:
         return handle
 
     # ─────────────────────────── commit ───────────────────────────────
-    def commit(self):
-        self._guard()
-        if not self._mutation_log and not self._write_conflicts:
-            # read-only: nothing to resolve (ref: read-only commits skip proxies)
-            self._state = "committed"
-            self._activate_watches()
-            return
+    def _build_commit_request(self):
         rv = self.get_read_version()
-        req = CommitRequest(
+        return CommitRequest(
             read_version=rv,
             mutations=list(self._mutation_log),
             read_conflict_ranges=_coalesce(self._read_conflicts),
             write_conflict_ranges=_coalesce(self._write_conflicts),
             report_conflicting_keys=self._report_conflicting_keys,
         )
-        result = self._cluster.commit_proxy.commit(req)
+
+    def _finish_commit(self, result):
         if isinstance(result, FDBError):
             self._state = "error"
             raise result
@@ -404,6 +399,44 @@ class Transaction:
         self._versionstamp = Versionstamp.from_version(result).tr_version
         self._state = "committed"
         self._activate_watches()
+
+    def commit(self):
+        self._guard()
+        if not self._mutation_log and not self._write_conflicts:
+            # read-only: nothing to resolve (ref: read-only commits skip proxies)
+            self._state = "committed"
+            self._activate_watches()
+            return
+        self._finish_commit(
+            self._cluster.commit_proxy.commit(self._build_commit_request())
+        )
+
+    def commit_async(self):
+        """Submit to the batching commit proxy; returns a CommitFuture.
+
+        The cooperative-actor commit path (ref: Transaction::commit is an
+        ACTOR returning Future<Void>): the caller yields until
+        ``fut.done()``, then calls :meth:`commit_finish` to apply the
+        outcome. Requires the cluster's proxy to support ``submit``
+        (BatchingCommitProxy); the plain synchronous proxy does not.
+        """
+        self._guard()
+        if not self._mutation_log and not self._write_conflicts:
+            from foundationdb_tpu.server.batcher import CommitFuture
+
+            self._state = "committed"
+            self._activate_watches()
+            fut = CommitFuture()
+            fut.set(None)
+            return fut
+        return self._cluster.commit_proxy.submit(self._build_commit_request())
+
+    def commit_finish(self, fut):
+        """Apply a resolved commit_async future (raises FDBError on
+        conflict, exactly like commit())."""
+        if self._state == "committed":  # read-only fast path already done
+            return
+        self._finish_commit(fut.result(timeout=0))
 
     def _activate_watches(self):
         for h in self._watches_pending:

