@@ -483,7 +483,13 @@ def main():
     # end-to-end pipeline number alongside the kernel-only number (point
     # mode only; BENCH_E2E=0 skips)
     if point and env("BENCH_E2E", "1") != "0":
-        out.update(run_e2e(cpu))
+        # the kernel number above is already computed and must survive an
+        # e2e mishap (wedged batcher thread, straggler submit after close)
+        try:
+            out.update(run_e2e(cpu))
+        except Exception as e:
+            sys.stderr.write(f"e2e bench failed: {type(e).__name__}: {e}\n")
+            out["e2e_error"] = f"{type(e).__name__}: {e}"[:200]
     watchdog_finish()
     print(json.dumps(out))
 

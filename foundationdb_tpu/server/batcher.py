@@ -13,8 +13,10 @@ Two drive modes:
   collects submissions for up to ``interval_s`` (or until ``max_batch``),
   then runs the inner proxy's commit_batch once. Clients block on a
   CommitFuture. The inner pipeline (resolve → tlog → storage apply) runs
-  only on the batcher thread, so server state needs no locking; client
-  threads only read storage (GIL-atomic dict reads) and enqueue.
+  only on the batcher thread; client threads read storage under each
+  StorageServer's mutation lock (storage.py ``_mu``), which the apply/
+  flush path also takes — point and range reads are consistent even
+  while the batcher mutates the overlay.
 
 - **manual** (deterministic simulation): no thread, no wall clock.
   Actors submit and yield on the future; the sim scheduler calls
@@ -77,6 +79,7 @@ class BatchingCommitProxy:
         self.batches_committed = 0
         self.txns_batched = 0
         self.max_batch_seen = 0
+        self.last_batch_error = None
         self._thread = None
         if mode == "thread":
             self._thread = threading.Thread(
@@ -136,11 +139,18 @@ class BatchingCommitProxy:
             chunk, pending = pending[: self.max_batch], pending[self.max_batch:]
             try:
                 results = self.inner.commit_batch([r for r, _ in chunk])
-            except Exception as e:  # resolve/apply blew up: fail the batch
+            except Exception as e:  # resolve/apply blew up: fail the chunk
+                # Never propagate: every future must resolve (an escaped
+                # exception would kill the batcher thread and leave later
+                # chunks' clients blocked forever) and the remaining
+                # chunks still deserve their shot. The pipeline may or may
+                # not have made the chunk durable — exactly what
+                # commit_unknown_result (1021) means.
+                self.last_batch_error = e
                 for _, fut in chunk:
                     fut.set(e if isinstance(e, FDBError) else
                             FDBError.from_name("commit_unknown_result"))
-                raise
+                continue
             self.batches_committed += 1
             self.txns_batched += len(chunk)
             self.max_batch_seen = max(self.max_batch_seen, len(chunk))
@@ -161,7 +171,12 @@ class BatchingCommitProxy:
                 pending, self._pending = self._pending, []
                 self._first_pending_step = None
             if pending:
-                self._run_batch(pending)
+                try:
+                    self._run_batch(pending)
+                except BaseException as e:  # pragma: no cover — last resort
+                    # _run_batch resolves futures itself; this guard only
+                    # keeps the batcher alive if future.set's internals fail
+                    self.last_batch_error = e
 
     def fail_pending(self, error):
         """Resolve every queued commit with ``error`` — a cluster crash
@@ -178,7 +193,12 @@ class BatchingCommitProxy:
             self._closed = True
             self._wake.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # still mid-batch (e.g. first-dispatch JIT compile): the
+                # batcher owns the pipeline; flushing from this thread
+                # would interleave two commit_batch runs on shared state
+                return
         self.flush()
 
     # pass everything else (commit_count, pump_durability, …) through

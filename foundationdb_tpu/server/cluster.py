@@ -14,7 +14,9 @@ import itertools
 
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.resolver.resolver import Resolver
-from foundationdb_tpu.server.coordination import CoordinationQuorum
+from foundationdb_tpu.server.coordination import (
+    CoordinationQuorum, CoordinatorDown, GenerationConflict,
+)
 from foundationdb_tpu.server.datadistribution import DataDistributor
 from foundationdb_tpu.server.grv import GrvProxy
 from foundationdb_tpu.server.proxy import CommitProxy
@@ -32,6 +34,7 @@ class Cluster:
                  coordination=None, n_coordinators=3, coordination_dir=None,
                  replication=None, commit_pipeline="sync",
                  commit_batch_max=None, commit_flush_after=4,
+                 target_tps=None, rk_clock=None,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -40,7 +43,10 @@ class Cluster:
                 else DEFAULT_KNOBS
             )
         self.knobs = knobs
-        self.ratekeeper = Ratekeeper()
+        self.ratekeeper = Ratekeeper(
+            target_tps=target_tps if target_tps is not None else 1e9,
+            clock=rk_clock,
+        )
         if storage_engines is None:
             storage_engines = [None] * n_storage
         elif len(storage_engines) != n_storage:
@@ -76,11 +82,23 @@ class Cluster:
         self.coordination = coordination or CoordinationQuorum.local(
             n_coordinators, coordination_dir
         )
-        prior = self.coordination.read_quorum() or {}
-        self.generation = prior.get("generation", 0) + 1
-        self.coordination.write_quorum(
-            {"generation": self.generation, "recovered_version": recovered}
-        )
+        # Generation lock is a CAS: read g, commit g+1 expecting g — two
+        # concurrent recoveries cannot both win the slot (the loser sees
+        # GenerationConflict, re-reads, and bids for the next slot).
+        for _ in range(10):
+            prior = self.coordination.read_quorum() or {}
+            self.generation = prior.get("generation", 0) + 1
+            try:
+                self.coordination.write_quorum(
+                    {"generation": self.generation,
+                     "recovered_version": recovered},
+                    expect_generation=self.generation - 1,
+                )
+                break
+            except GenerationConflict:
+                continue
+        else:
+            raise CoordinatorDown("could not win a recovery generation")
         TraceEvent("MasterRecovered").detail(
             generation=self.generation, version=recovered).log()
 

@@ -30,6 +30,16 @@ class CoordinatorDown(Exception):
     pass
 
 
+class GenerationConflict(Exception):
+    """A CAS write found a different generation already committed — a
+    competing recovery won the slot (ref: the coordinated-state lock
+    making concurrent master recoveries mutually exclusive)."""
+
+    def __init__(self, prior):
+        super().__init__(f"coordinated state moved: {prior!r}")
+        self.prior = prior
+
+
 class _BallotOutdated(Exception):
     """A majority is reachable but promised a higher ballot (another
     proposer, or our own pre-restart incarnation). Retryable."""
@@ -150,15 +160,26 @@ class CoordinationQuorum:
         value, _ = self._prepare_retrying()
         return value
 
-    def write_quorum(self, state):
+    def write_quorum(self, state, expect_generation=None):
         """Commit ``state`` as the new cluster state via full Paxos.
+
+        With ``expect_generation``, the write is a compare-and-swap: each
+        round's phase 1 re-reads the highest accepted state, and if its
+        generation no longer matches, GenerationConflict is raised — so
+        two concurrent recoveries that both read generation g cannot both
+        commit g+1 (whichever loses the ballot race observes the winner's
+        value when it retries). Without it, the slot is overwritten
+        unconditionally.
 
         Raises CoordinatorDown if no majority is reachable. Returns the
         ballot at which the state was committed.
         """
         for _ in range(10):  # retry on ballot races with other proposers
             prior, ballot = self._prepare_retrying()
-            del prior  # we overwrite regardless: recovery owns the slot
+            if expect_generation is not None:
+                prior_gen = (prior or {}).get("generation", 0)
+                if prior_gen != expect_generation:
+                    raise GenerationConflict(prior)
             acks = 0
             for c in self.coordinators:
                 try:

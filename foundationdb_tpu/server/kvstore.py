@@ -159,7 +159,10 @@ class KeyValueStoreSQLite:
 
     def __init__(self, path, fsync=False):
         self.path = path
-        self._conn = sqlite3.connect(path)
+        # check_same_thread=False: in thread-mode batching the batcher
+        # thread flushes into an engine the client thread opened; the
+        # storage server's mutation lock serializes all access
+        self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute(f"PRAGMA synchronous={'FULL' if fsync else 'NORMAL'}")
         self._conn.execute(

@@ -16,6 +16,7 @@ same two-loop shape:
   trims it, recovering multiplicatively when health returns.
 """
 
+import threading
 import time
 
 
@@ -26,37 +27,50 @@ class Ratekeeper:
     CONFLICT_TRIM = 0.5  # conflict ratio above which the budget is trimmed
     FLOOR_FRACTION = 0.01
 
-    def __init__(self, target_tps=1e9, batch_priority_fraction=0.5):
+    def __init__(self, target_tps=1e9, batch_priority_fraction=0.5, clock=None):
         self.max_tps = target_tps
         self.target_tps = target_tps
         self.batch_priority_fraction = batch_priority_fraction
+        # Injectable clock so the deterministic simulation can drive the
+        # token bucket off its step counter instead of wall time (admission
+        # results must replay byte-identically under a seed).
+        self.clock = clock if clock is not None else time.monotonic
         self._tokens = target_tps
-        self._last_refill = time.monotonic()
+        self._last_refill = self.clock()
         self._recent_txns = 0
         self._recent_conflicts = 0
+        self.throttled_count = 0  # GRV requests rejected at the gate
+        # thread-mode clusters admit from many client threads while the
+        # batcher thread feeds observe_commit/update: the token bucket's
+        # read-modify-write must not interleave
+        self._mu = threading.Lock()
 
     # ── GRV-edge enforcement (ref: GrvProxy transaction budgets) ──
     def admit(self, priority="default"):
-        now = time.monotonic()
-        self._tokens = min(
-            self.target_tps, self._tokens + (now - self._last_refill) * self.target_tps
-        )
-        self._last_refill = now
+        if priority == "immediate":
+            return True  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
         need = 1.0
         if priority == "batch":
             # batch priority only runs when spare capacity exists
             need = 1.0 / max(self.batch_priority_fraction, 1e-6)
-        elif priority == "immediate":
-            return True  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
-        if self._tokens >= need:
-            self._tokens -= need
-            return True
-        return False
+        with self._mu:
+            now = self.clock()
+            self._tokens = min(
+                self.target_tps,
+                self._tokens + (now - self._last_refill) * self.target_tps,
+            )
+            self._last_refill = now
+            if self._tokens >= need:
+                self._tokens -= need
+                return True
+            self.throttled_count += 1
+            return False
 
     def observe_commit(self, txns, conflicts):
         """Both arguments are per-batch increments."""
-        self._recent_txns += txns
-        self._recent_conflicts += conflicts
+        with self._mu:
+            self._recent_txns += txns
+            self._recent_conflicts += conflicts
 
     # ── control loop (ref: Ratekeeper::updateRate) ──
     def update(self, storage_lag_versions=0):
@@ -66,6 +80,10 @@ class Ratekeeper:
         storage's durable version (the cluster computes it; simulation
         pumps this deterministically).
         """
+        with self._mu:
+            return self._update_locked(storage_lag_versions)
+
+    def _update_locked(self, storage_lag_versions):
         floor = self.max_tps * self.FLOOR_FRACTION
         # storage spring: full rate below LAG_SOFT, linear squeeze to the
         # floor at LAG_HARD (the reference's smoothed storage queue term)

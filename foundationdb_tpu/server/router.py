@@ -14,6 +14,7 @@ so the transaction layer is placement-agnostic: full replication is
 just the one-shard case.
 """
 
+from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.server.storage import RangeReadInterface
 
 
@@ -32,7 +33,15 @@ class StorageRouter(RangeReadInterface):
 
     # ── single-storage invariants preserved across the tier ──
     def _check_version(self, version):
-        self.storages[0]._check_version(version)
+        """Cheap global bounds; the authoritative floor check is per
+        consulted storage inside _iter_live, because floors diverge the
+        moment a joiner ingests a shard (its floor rises to the source's)
+        — a read between two floors must fail TOO_OLD on the raised-floor
+        shard, never silently omit its keys."""
+        if version < min(s.oldest_version for s in self.storages):
+            raise err("transaction_too_old")
+        if version > max(s.version for s in self.storages):
+            raise err("future_version")
 
     @property
     def version(self):
@@ -65,4 +74,5 @@ class StorageRouter(RangeReadInterface):
             else:
                 e = min(end, se)
             storage = self._pick(self.map.teams[i])
+            storage._check_version(version)
             yield from storage._iter_live(b, e, version, reverse=reverse)

@@ -12,6 +12,7 @@ reference's updateStorage loop making versions durable then popping the
 tlog.
 """
 
+import threading
 from collections import deque
 
 from sortedcontainers import SortedDict
@@ -124,6 +125,12 @@ class StorageServer(RangeReadInterface):
         # versions > durable_version; None = tombstone
         self._overlay = SortedDict()
         self._dirty = deque()  # (version, key) in apply order, for flush
+        # Guards overlay/engine mutation vs reads: in thread-mode batching
+        # the batcher thread applies/flushes while client threads read.
+        # SortedDict iteration is not safe under concurrent mutation, so
+        # readers hold the same lock (RLock: flush iterates internally).
+        # Single-threaded deployments pay one uncontended acquire per op.
+        self._mu = threading.RLock()
         self.engine = engine if engine is not None else KeyValueStoreMemory()
         self.durable_version = self.engine.stored_version()
         self.oldest_version = self.durable_version
@@ -146,17 +153,18 @@ class StorageServer(RangeReadInterface):
         """Apply one commit's mutations at ``version`` (monotone order)."""
         if version <= self.version:
             raise ValueError(f"apply out of order: {version} <= {self.version}")
-        for m in mutations:
-            if m.op is Op.CLEAR_RANGE:
-                self._apply_clear_range(m.key, m.param, version)
-            elif m.op in (Op.SET, Op.CLEAR):
-                self._append(m.key, version, m.param if m.op is Op.SET else None)
-            elif m.op in ATOMIC_OPS:
-                old = self._lookup(m.key, version)
-                self._append(m.key, version, apply_atomic(m.op, old, m.param))
-            else:
-                raise ValueError(f"unresolved mutation {m.op} reached storage")
-        self.version = version
+        with self._mu:
+            for m in mutations:
+                if m.op is Op.CLEAR_RANGE:
+                    self._apply_clear_range(m.key, m.param, version)
+                elif m.op in (Op.SET, Op.CLEAR):
+                    self._append(m.key, version, m.param if m.op is Op.SET else None)
+                elif m.op in ATOMIC_OPS:
+                    old = self._lookup(m.key, version)
+                    self._append(m.key, version, apply_atomic(m.op, old, m.param))
+                else:
+                    raise ValueError(f"unresolved mutation {m.op} reached storage")
+            self.version = version
 
     def _apply_clear_range(self, begin, end, version):
         # tombstone every key the clear shadows: overlay keys in range plus
@@ -188,6 +196,10 @@ class StorageServer(RangeReadInterface):
         up_to_version = min(up_to_version, self.version)
         if up_to_version <= self.durable_version:
             return self.durable_version
+        with self._mu:
+            return self._flush_locked(up_to_version)
+
+    def _flush_locked(self, up_to_version):
         # the dirty queue is version-ordered, so flushing touches only keys
         # actually written at-or-below the target (ref: the version-ordered
         # update queue in the storage server's updateStorage loop)
@@ -244,7 +256,8 @@ class StorageServer(RangeReadInterface):
 
     def get(self, key, version):
         self._check_version(version)
-        return self._lookup(key, version)
+        with self._mu:
+            return self._lookup(key, version)
 
     def _overlay_at(self, key, version):
         """Newest overlay value at-or-below ``version`` (or _MISS)."""
@@ -259,7 +272,16 @@ class StorageServer(RangeReadInterface):
     def _iter_live(self, begin, end, version, reverse=False):
         """Lazy merged (key, value) iteration of engine + overlay at
         ``version`` — overlay wins ties; pulls the engine cursor only as
-        far as the caller consumes (limit pushdown)."""
+        far as the caller consumes (limit pushdown).
+
+        Holds the mutation lock for the duration of the iteration: every
+        in-package consumer drains (or drops) the generator within one
+        call, so the lock's critical section ends when that call returns
+        (CPython closes the abandoned generator at function exit)."""
+        with self._mu:
+            yield from self._iter_live_locked(begin, end, version, reverse)
+
+    def _iter_live_locked(self, begin, end, version, reverse=False):
         sentinel = object()
         ov = iter(self._overlay.irange(begin, end, inclusive=(True, False), reverse=reverse))
         base = self.engine.iter_range(begin, end, reverse=reverse)
@@ -297,17 +319,18 @@ class StorageServer(RangeReadInterface):
         distribution hands this to joiners so reads at pre-move read
         versions stay correct (ref: fetchKeys streaming + the mutation
         buffer that brings a joining storage up to date)."""
-        base = dict(self.engine.iter_range(begin, end))
-        keys = set(base)
-        keys.update(self._overlay.irange(begin, end, inclusive=(True, False)))
-        rows = []
-        for k in sorted(keys):
-            chain = []
-            if k in base:
-                chain.append((self.durable_version, base[k]))
-            chain.extend(self._overlay.get(k, ()))
-            rows.append((k, chain))
-        return (self.oldest_version, self.version, rows)
+        with self._mu:
+            base = dict(self.engine.iter_range(begin, end))
+            keys = set(base)
+            keys.update(self._overlay.irange(begin, end, inclusive=(True, False)))
+            rows = []
+            for k in sorted(keys):
+                chain = []
+                if k in base:
+                    chain.append((self.durable_version, base[k]))
+                chain.extend(self._overlay.get(k, ()))
+                rows.append((k, chain))
+            return (self.oldest_version, self.version, rows)
 
     def ingest_shard(self, begin, end, export):
         """Install an ``export_shard`` snapshot (ref: fetchKeys applying
@@ -318,15 +341,16 @@ class StorageServer(RangeReadInterface):
         TOO_OLD (retryable) is the correct answer, exactly as a version
         older than the window gets everywhere else."""
         oldest, version, rows = export
-        self.version = max(self.version, version)
-        self.oldest_version = max(self.oldest_version, oldest)
-        self.engine.clear_range(begin, end)
-        for k in list(self._overlay.irange(begin, end, inclusive=(True, False))):
-            del self._overlay[k]
-        for k, chain in rows:
-            self._overlay[k] = list(chain)
-            for v, _ in chain:
-                self._dirty.append((v, k))
+        with self._mu:
+            self.version = max(self.version, version)
+            self.oldest_version = max(self.oldest_version, oldest)
+            self.engine.clear_range(begin, end)
+            for k in list(self._overlay.irange(begin, end, inclusive=(True, False))):
+                del self._overlay[k]
+            for k, chain in rows:
+                self._overlay[k] = list(chain)
+                for v, _ in chain:
+                    self._dirty.append((v, k))
 
     # ───────────────────────────── watches ─────────────────────────────
     def fire_watches_in_range(self, begin, end):
@@ -335,19 +359,21 @@ class StorageServer(RangeReadInterface):
         owner instead of hanging on a storage that stopped receiving the
         key's mutations (ref: watches erroring with wrong_shard_server
         on shard moves; ours wakes instead of erroring)."""
-        for key in list(self._watches):
-            if begin <= key and (end is None or key < end):
-                for w in self._watches.pop(key):
-                    w._fire()
+        with self._mu:  # vs concurrent watch() registration / _append firing
+            for key in list(self._watches):
+                if begin <= key and (end is None or key < end):
+                    for w in self._watches.pop(key):
+                        w._fire()
 
     def watch(self, key, seen_value):
-        w = Watch(key, seen_value)
-        current = self._lookup(key, self.version)
-        if current != seen_value:
-            w._fire()
-        else:
-            self._watches.setdefault(key, []).append(w)
-        return w
+        with self._mu:
+            w = Watch(key, seen_value)
+            current = self._lookup(key, self.version)
+            if current != seen_value:
+                w._fire()
+            else:
+                self._watches.setdefault(key, []).append(w)
+            return w
 
     def advance_window(self, oldest):
         """Advance the MVCC read floor. Folding old overlay versions into

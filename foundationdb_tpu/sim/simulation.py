@@ -94,6 +94,11 @@ class FaultyGrvProxy:
 
 
 class Simulation:
+    # Simulated seconds per scheduling step: the deterministic clock the
+    # ratekeeper's token bucket refills from (ref: sim2's g_simulator time
+    # advancing at task boundaries, never wall time).
+    SIM_DT = 0.001
+
     def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
                  datadir=None, **cluster_kwargs):
         self.seed = seed
@@ -134,12 +139,19 @@ class Simulation:
             # coordinators persist beside the WAL so crash_and_recover
             # exercises the real quorum-locking recovery path
             coordination_dir=self.datadir,
+            # admission control ticks on simulated time: same seed, same
+            # schedule, same throttling decisions
+            rk_clock=lambda: self.steps * self.SIM_DT,
             **self.cluster_kwargs,
         )
         self.cluster.commit_proxy = FaultyCommitProxy(
             self.cluster.commit_proxy, self.buggify
         )
         self.cluster.grv_proxy = FaultyGrvProxy(self.cluster.grv_proxy, self.buggify)
+        # resolved once per incarnation: the scheduler pumps manual-mode
+        # batching every step, and a per-step hasattr through the fault
+        # wrapper's __getattr__ would pay an exception per miss
+        self._pump = getattr(self.cluster.commit_proxy, "pump", None)
 
     def crash_and_recover(self):
         """Kill the cluster (losing all volatile state) and restart from
@@ -184,9 +196,8 @@ class Simulation:
                 live.pop(i)
             # manual-mode batching: the scheduler is the batch clock
             # (deterministic analog of the proxy's commit interval)
-            cp = self.cluster.commit_proxy
-            if hasattr(cp, "pump"):
-                cp.pump(self.steps)
+            if self._pump is not None:
+                self._pump(self.steps)
         self._actors = []
 
     def quiesce(self):

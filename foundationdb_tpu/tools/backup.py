@@ -51,22 +51,31 @@ class BackupAgent:
         """Cut a consistent range snapshot at one read version."""
         tr = self.db.create_transaction()
         v = tr.get_read_version()
+        # pin the tlog BEFORE the scan: commits interleaved during the
+        # scan (a yielding snapshot, other actors) plus a durability pump
+        # must not pop records in (v, durable] before the hold exists —
+        # those versions belong to the backup log
+        self.db._cluster.tlog.hold_pop(f"backup@{id(self)}", v)
         path = os.path.join(self.dir, f"snapshot-{v}.jsonl")
-        with open(path, "w") as f:
-            begin = b""
-            while True:
-                rows = tr.get_range(begin, b"\xff", limit=chunk, snapshot=True)
-                for k, val in rows:
-                    f.write(json.dumps({"k": _enc(k), "v": _enc(val)}) + "\n")
-                if len(rows) < chunk:
-                    break
-                begin = rows[-1][0] + b"\x00"
+        try:
+            with open(path, "w") as f:
+                begin = b""
+                while True:
+                    rows = tr.get_range(begin, b"\xff", limit=chunk, snapshot=True)
+                    for k, val in rows:
+                        f.write(json.dumps({"k": _enc(k), "v": _enc(val)}) + "\n")
+                    if len(rows) < chunk:
+                        break
+                    begin = rows[-1][0] + b"\x00"
+        except BaseException:
+            # a failed scan (TOO_OLD on a huge keyspace, IO error) must not
+            # leave the tlog pinned at v forever
+            self.db._cluster.tlog.release_pop(f"backup@{id(self)}")
+            raise
         self.snapshot_version = v
-        # the log must cover (snapshot_version, target]; start it here and
-        # pin the tlog so durability pops cannot outrun our pulls
+        # the log covers (snapshot_version, target], anchored at v
         self._log_from = v
         self._log_through = v
-        self.db._cluster.tlog.hold_pop(f"backup@{id(self)}", v)
         self._write_manifest()
         return v
 

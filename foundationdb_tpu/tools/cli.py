@@ -190,7 +190,14 @@ class Cli:
         if self.tr is None:
             self._p("ERROR: No active transaction")
             return
-        self.tr.commit()
+        # a failed commit still ends the transaction (real fdbcli resets
+        # on commit failure — later commands must not keep hitting the
+        # dead transaction's used-commit state)
+        try:
+            self.tr.commit()
+        except BaseException:
+            self.tr = None
+            raise
         self._p(f"Committed ({self.tr.get_committed_version()})")
         self.tr = None
 

@@ -158,7 +158,7 @@ class Transaction:
 
     # ───────────────────────────── reads ──────────────────────────────
     def _guard(self):
-        if self._state == "committed":
+        if self._state in ("committed", "committing"):
             raise err("used_during_commit")
         if self._state == "cancelled":
             raise err("transaction_cancelled")
@@ -429,7 +429,12 @@ class Transaction:
             fut = CommitFuture()
             fut.set(None)
             return fut
-        return self._cluster.commit_proxy.submit(self._build_commit_request())
+        req = self._build_commit_request()
+        # in-flight: further ops (or a second commit) must fail
+        # used_during_commit, not silently re-submit the mutation log
+        # (ref: used_during_commit in NativeAPI while the commit actor runs)
+        self._state = "committing"
+        return self._cluster.commit_proxy.submit(req)
 
     def commit_finish(self, fut):
         """Apply a resolved commit_async future (raises FDBError on

@@ -151,6 +151,26 @@ class TestCli:
         assert "Committed (" in out
         assert db.get(b"a") == b"1" and db.get(b"b") == b"2"
 
+    def test_failed_commit_resets_txn(self):
+        """A conflicted explicit commit ends the transaction (real fdbcli
+        resets on commit failure) — the next begin/commit works instead
+        of hitting the dead transaction's used-commit state."""
+        db = fresh_db()
+        out = io.StringIO()
+        cli = Cli(db, out=out)
+        cli.write_mode = True
+        cli.run_command("begin")
+        cli.run_command("get a")
+        cli.run_command("set a 1")
+        db.set(b"a", b"other")  # invalidate the open txn's read
+        cli.run_command("commit")
+        assert "ERROR" in out.getvalue() and "1020" in out.getvalue()
+        assert cli.tr is None
+        for c in ("begin", "set a 2", "commit"):
+            cli.run_command(c)
+        assert "Committed (" in out.getvalue()
+        assert db.get(b"a") == b"2"
+
     def test_txn_reset_discards(self):
         db = fresh_db()
         self.run(db, "begin", "set a 1", "reset")

@@ -192,3 +192,105 @@ def test_fail_pending_resolves_futures():
     with pytest.raises(FDBError) as ei:
         tr.commit_finish(fut)
     assert ei.value.code == 1021
+
+
+def test_batcher_survives_poisoned_batch():
+    """An exception escaping the inner pipeline must fail that chunk's
+    futures with 1021 and leave the batcher thread alive for later
+    commits — not deadlock every subsequent client (round-2 review
+    finding: the re-raise killed the thread)."""
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.server.cluster import Cluster
+    from tests.conftest import TEST_KNOBS
+
+    c = Cluster(commit_pipeline="thread", commit_flush_after=1, **TEST_KNOBS)
+    db = c.database()
+    inner = c.commit_proxy.inner
+    orig = inner.commit_batch
+    state = {"raised": False}
+
+    def boom(reqs):
+        if not state["raised"]:
+            state["raised"] = True
+            raise IOError("disk full (injected)")
+        return orig(reqs)
+
+    inner.commit_batch = boom
+    tr = db.create_transaction()
+    tr.set(b"k", b"1")
+    try:
+        tr.commit()
+        raise AssertionError("expected commit_unknown_result")
+    except FDBError as e:
+        assert e.code == 1021
+    db.set(b"k", b"2")  # the batcher thread must still be draining
+    assert db.get(b"k") == b"2"
+    assert isinstance(c.commit_proxy.last_batch_error, IOError)
+    c.commit_proxy.close()
+
+
+def test_thread_mode_concurrent_range_reads_consistent():
+    """Client threads range-read while the batcher thread applies and
+    flushes: the storage mutation lock must keep SortedDict iteration
+    safe (round-2 review finding: reads raced overlay mutation)."""
+    import threading
+
+    from foundationdb_tpu.server.cluster import Cluster
+    from tests.conftest import TEST_KNOBS
+
+    c = Cluster(commit_pipeline="thread", commit_flush_after=1, **TEST_KNOBS)
+    c.commit_proxy.inner.pump_interval = 2  # flush (engine mutation) often
+    db = c.database()
+    for i in range(50):
+        db.set(b"seed%03d" % i, b"v")
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                rows = db.get_range(b"seed", b"seee")
+                assert len(rows) >= 50, len(rows)
+            except Exception as e:  # pragma: no cover — the regression
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(200):
+            db.set(b"w%04d" % i, b"x" * 50)
+            if i % 37 == 0:
+                db.clear_range(b"w", b"w\x03")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+    c.commit_proxy.close()
+
+
+def test_commit_async_inflight_guards_reuse():
+    """While a commit_async is in flight the transaction is 'committing':
+    a second commit (or further mutations) must raise used_during_commit
+    instead of re-submitting the same mutation log as an independent
+    commit (round-2 review finding: a blind ADD applied twice)."""
+    import pytest
+
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.server.cluster import Cluster
+    from tests.conftest import TEST_KNOBS
+
+    c = Cluster(commit_pipeline="manual", **TEST_KNOBS)
+    db = c.database()
+    tr = db.create_transaction()
+    tr.add(b"ctr", (1).to_bytes(8, "little"))
+    fut = tr.commit_async()
+    for op in (tr.commit_async, tr.commit, lambda: tr.set(b"x", b"y")):
+        with pytest.raises(FDBError) as ei:
+            op()
+        assert ei.value.code == 2017  # used_during_commit
+    c.commit_proxy.flush()
+    tr.commit_finish(fut)
+    assert int.from_bytes(db.get(b"ctr"), "little") == 1

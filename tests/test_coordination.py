@@ -95,3 +95,30 @@ def test_cluster_generation_advances(tmp_path):
     c2 = Cluster(coordination_dir=str(tmp_path), **TEST_KNOBS)
     assert c2.generation == g1 + 1
     assert c2.status()["cluster"]["generation"] == g1 + 1
+
+
+def test_cas_write_fences_competing_recovery():
+    """The generation lock is a CAS: two proposers that both read
+    generation g cannot both commit g+1 — the loser gets
+    GenerationConflict and must re-read (round-1 advisor finding: the
+    read-modify-write was not atomic)."""
+    from foundationdb_tpu.server.coordination import GenerationConflict
+
+    import pytest
+
+    coords = [Coordinator() for _ in range(3)]
+    a = CoordinationQuorum(coords, proposer_id=0, n_proposers=2)
+    b = CoordinationQuorum(coords, proposer_id=1, n_proposers=2)
+    a.write_quorum({"generation": 3})
+    # both recoveries observe g=3 and bid for slot 4
+    ga = a.read_quorum()["generation"]
+    gb = b.read_quorum()["generation"]
+    assert ga == gb == 3
+    a.write_quorum({"generation": 4, "who": "a"}, expect_generation=3)
+    with pytest.raises(GenerationConflict) as ei:
+        b.write_quorum({"generation": 4, "who": "b"}, expect_generation=3)
+    assert ei.value.prior["who"] == "a"
+    # the loser re-reads and takes the NEXT slot cleanly
+    g = b.read_quorum()["generation"]
+    b.write_quorum({"generation": g + 1, "who": "b"}, expect_generation=g)
+    assert a.read_quorum() == {"generation": 5, "who": "b"}

@@ -143,6 +143,30 @@ def test_relocation_preserves_mvcc_history(cluster):
     assert dict(tr.get_range(b"g", b"n", snapshot=True))[b"golf"] == b"v-golf"
 
 
+def test_range_read_between_diverged_floors_raises_too_old(cluster):
+    """When one consulted storage's read floor has risen past the read
+    version (e.g. a joiner after ingest_shard), a range read spanning it
+    must raise transaction_too_old — not silently omit that shard's keys
+    (round-1 advisor finding: only storages[0]'s floor was checked)."""
+    from foundationdb_tpu.core.errors import FDBError
+
+    db = cluster.database()
+    fill(db)
+    tr = db.create_transaction()
+    rv = tr.get_read_version()
+    # push the cluster version forward, then raise the floor of shard 1's
+    # replicas ([1, 2]) past rv, as an ingest from a flushed source would
+    for i in range(3):
+        db.set(b"bump%d" % i, b"x")
+    for sid in (1, 2):
+        cluster.storages[sid].oldest_version = rv + 1
+    with pytest.raises(FDBError) as ei:
+        tr.get_range(b"", b"\xff", snapshot=True)
+    assert ei.value.code == 1007  # transaction_too_old
+    # a range not touching the raised-floor shard still reads fine
+    assert tr.get_range(b"u", b"\xff", snapshot=True) == [(b"zulu", b"v-zulu")]
+
+
 def test_atomic_ops_route(cluster):
     db = cluster.database()
     db.add(b"golf", (5).to_bytes(8, "little"))
