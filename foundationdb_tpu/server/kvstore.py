@@ -24,16 +24,15 @@ from sortedcontainers import SortedDict
 _META_VERSION_KEY = b"\xff\xff/kvstore_version"
 
 
-class KeyValueStoreMemory:
-    """Ordered in-RAM map, optionally durable via snapshot + op WAL.
-
-    Ref: KeyValueStoreMemory — every mutation is logged to a DiskQueue;
-    a periodic snapshot bounds replay. Recovery = load snapshot, replay
-    the op log, tolerate a torn tail.
+class WalEngineBase:
+    """Shared durability plumbing: length+CRC-framed op WAL with periodic
+    snapshot compaction and torn-tail-tolerant recovery (ref: the
+    DiskQueue + snapshot pattern both memory-backed reference engines
+    use). Subclasses implement ``_apply_record`` (replay one op),
+    ``_snapshot_state`` / ``_load_snapshot`` (full-state serialization).
     """
 
     def __init__(self, path=None, fsync=False, snapshot_every_ops=50_000):
-        self._data = SortedDict()
         self._version = 0
         self.path = path
         self.fsync = fsync
@@ -51,6 +50,86 @@ class KeyValueStoreMemory:
     @property
     def _wal_path(self):
         return self.path + ".oplog"
+
+    def _log(self, op):
+        if self._wal is None:
+            return
+        payload = pickle.dumps(op, protocol=4)
+        self._wal.write(struct.pack(">II", len(payload), zlib.crc32(payload)) + payload)
+        self._ops_since_snapshot += 1
+
+    def commit(self, version):
+        self._commit_version(version)
+        self._log(("v", version, None))
+        if self._wal is not None:
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            if self._ops_since_snapshot >= self._snapshot_every:
+                self.compact()
+
+    def _commit_version(self, version):
+        self._version = version
+
+    def compact(self):
+        """Snapshot the full state and truncate the op log so recovery
+        replay stays bounded."""
+        if self.path is None:
+            return
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._snapshot_state(), f, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+        self._ops_since_snapshot = 0
+
+    def _recover(self):
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                self._load_snapshot(pickle.load(f))
+        try:
+            with open(self._wal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        off = 0
+        while off + 8 <= len(raw):
+            ln, crc = struct.unpack_from(">II", raw, off)
+            if off + 8 + ln > len(raw):
+                break  # torn tail
+            payload = raw[off + 8 : off + 8 + ln]
+            if zlib.crc32(payload) != crc:
+                break
+            kind, a, b = pickle.loads(payload)
+            if kind == "v":
+                self._commit_version(a)
+            else:
+                self._apply_record(kind, a, b)
+            off += 8 + ln
+        self._ops_since_snapshot = 0
+
+    def close(self):
+        if self._wal is not None:
+            self._wal.flush()
+            self._wal.close()
+            self._wal = None
+
+
+class KeyValueStoreMemory(WalEngineBase):
+    """Ordered in-RAM map, optionally durable via snapshot + op WAL.
+
+    Ref: KeyValueStoreMemory — every mutation is logged to a DiskQueue;
+    a periodic snapshot bounds replay. Recovery = load snapshot, replay
+    the op log, tolerate a torn tail.
+    """
+
+    def __init__(self, path=None, fsync=False, snapshot_every_ops=50_000):
+        self._data = SortedDict()
+        super().__init__(path, fsync, snapshot_every_ops)
 
     # ── reads ──
     def get(self, key):
@@ -86,71 +165,20 @@ class KeyValueStoreMemory:
             del self._data[k]
         self._log(("c", begin, end))
 
-    def commit(self, version):
-        self._version = version
-        self._log(("v", version, None))
-        if self._wal is not None:
-            self._wal.flush()
-            if self.fsync:
-                os.fsync(self._wal.fileno())
-            if self._ops_since_snapshot >= self._snapshot_every:
-                self.compact()
+    # ── WalEngineBase hooks ──
+    def _snapshot_state(self):
+        return (self._version, dict(self._data))
 
-    def _log(self, op):
-        if self._wal is None:
-            return
-        payload = pickle.dumps(op, protocol=4)
-        self._wal.write(struct.pack(">II", len(payload), zlib.crc32(payload)) + payload)
-        self._ops_since_snapshot += 1
+    def _load_snapshot(self, state):
+        self._version, data = state
+        self._data = SortedDict(data)
 
-    def compact(self):
-        """Snapshot the full state and truncate the op log (ref: the memory
-        engine's periodic snapshot so recovery replay stays bounded)."""
-        if self.path is None:
-            return
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump((self._version, dict(self._data)), f, protocol=4)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
-        if self._wal is not None:
-            self._wal.close()
-        self._wal = open(self._wal_path, "wb")
-        self._ops_since_snapshot = 0
-
-    def _recover(self):
-        if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                self._version, data = pickle.load(f)
-            self._data = SortedDict(data)
-        try:
-            with open(self._wal_path, "rb") as f:
-                raw = f.read()
-        except FileNotFoundError:
-            return
-        off = 0
-        while off + 8 <= len(raw):
-            ln, crc = struct.unpack_from(">II", raw, off)
-            if off + 8 + ln > len(raw):
-                break  # torn tail
-            payload = raw[off + 8 : off + 8 + ln]
-            if zlib.crc32(payload) != crc:
-                break
-            kind, a, b = pickle.loads(payload)
-            if kind == "s":
-                self._data[a] = b
-            elif kind == "c":
-                for k in list(self._data.irange(a, b, inclusive=(True, False))):
-                    del self._data[k]
-            elif kind == "v":
-                self._version = a
-            off += 8 + ln
-
-    def close(self):
-        if self._wal is not None:
-            self._wal.close()
-            self._wal = None
+    def _apply_record(self, kind, a, b):
+        if kind == "s":
+            self._data[a] = b
+        elif kind == "c":
+            for k in list(self._data.irange(a, b, inclusive=(True, False))):
+                del self._data[k]
 
 
 class KeyValueStoreSQLite:
@@ -230,12 +258,11 @@ class KeyValueStoreSQLite:
         self._conn.close()
 
 
-ENGINES = {"memory": KeyValueStoreMemory, "sqlite": KeyValueStoreSQLite}
-
-
 def open_engine(kind, path=None, **kw):
     if kind == "memory":
         return KeyValueStoreMemory(path, **kw)
+    if kind == "versioned":
+        return KeyValueStoreVersioned(path, **kw)
     if kind == "sqlite":
         if path is None:
             raise ValueError("sqlite engine requires a path")
@@ -243,7 +270,7 @@ def open_engine(kind, path=None, **kw):
     raise ValueError(f"unknown storage engine {kind!r}")
 
 
-class KeyValueStoreVersioned:
+class KeyValueStoreVersioned(WalEngineBase):
     """Versioned durable store — the Redwood-role engine.
 
     Ref parity: fdbserver/VersionedBTree.actor.cpp (Redwood) — the
@@ -267,24 +294,12 @@ class KeyValueStoreVersioned:
     def __init__(self, path=None, fsync=False, snapshot_every_ops=50_000):
         # key -> [(version, value|None), ...] ascending; None = tombstone
         self._chains = SortedDict()
-        self._version = 0
         self._oldest = 0  # oldest version with full history retained
-        self.path = path
-        self.fsync = fsync
-        self._ops_since_snapshot = 0
-        self._snapshot_every = snapshot_every_ops
-        self._wal = None
-        if path is not None:
-            self._recover()
-            self._wal = open(self._wal_path, "ab")
-
-    @property
-    def _snap_path(self):
-        return self.path + ".snap"
-
-    @property
-    def _wal_path(self):
-        return self.path + ".oplog"
+        # keys prune() must visit: chain length > 1, or a lone tombstone
+        # (so prune stays O(prunable), not O(total keys) — it runs on the
+        # commit path under the storage mutation lock)
+        self._prunable = set()
+        super().__init__(path, fsync, snapshot_every_ops)
 
     # ── versioned reads ──
     @staticmethod
@@ -308,6 +323,12 @@ class KeyValueStoreVersioned:
             val = self._at(self._chains[k], version)
             if val is not None:
                 yield k, val
+
+    def iter_chains(self, begin, end):
+        """Full (key, version-chain) pairs in [begin, end) — shard export
+        needs the engine-held history, not just the durable view."""
+        for k in list(self._chains.irange(begin, end, inclusive=(True, False))):
+            yield k, list(self._chains[k])
 
     # ── single-version facade (durable view — engine interface compat) ──
     def get(self, key):
@@ -338,6 +359,10 @@ class KeyValueStoreVersioned:
     def set_versioned(self, key, version, value):
         """Record ``value`` (None = tombstone) for key at version.
         Versions per key arrive ascending (flush order)."""
+        self._apply_set_versioned(key, version, value)
+        self._log(("sv", key, (version, value)))
+
+    def _apply_set_versioned(self, key, version, value):
         chain = self._chains.get(key)
         if chain is None:
             chain = []
@@ -346,7 +371,8 @@ class KeyValueStoreVersioned:
             chain[-1] = (version, value)
         else:
             chain.append((version, value))
-        self._log(("sv", key, (version, value)))
+        if len(chain) > 1 or value is None:
+            self._prunable.add(key)
 
     def set(self, key, value):
         # single-version compat (restore paths); records at the current
@@ -358,24 +384,34 @@ class KeyValueStoreVersioned:
             if self._at(self._chains[k], self._version) is not None:
                 self.set_versioned(k, self._version, None)
 
-    def commit(self, version):
-        self._version = max(self._version, version)
-        self._log(("v", version, None))
-        if self._wal is not None:
-            self._wal.flush()
-            if self.fsync:
-                os.fsync(self._wal.fileno())
-            if self._ops_since_snapshot >= self._snapshot_every:
-                self.compact()
+    def erase_range(self, begin, end):
+        """Physically delete all chains in [begin, end) — history and all.
+
+        This is NOT a clear (a clear is a tombstone write at a version);
+        shard ingest uses it to evict a stale pre-move copy so the
+        source's authoritative history can be installed without
+        interleaving out-of-order versions into surviving chains."""
+        for k in list(self._chains.irange(begin, end, inclusive=(True, False))):
+            del self._chains[k]
+            self._prunable.discard(k)
+        self._log(("e", begin, end))
 
     def prune(self, before_version):
         """Drop history below ``before_version``: each chain keeps its
         newest entry at-or-below it (the base any admissible read needs)
-        and everything newer (ref: Redwood trimming old page versions)."""
+        and everything newer (ref: Redwood trimming old page versions).
+        Visits only chains that can shrink (the _prunable set)."""
         if before_version <= self._oldest:
             return
-        dead = []
-        for k, chain in self._chains.items():
+        self._apply_prune(before_version)
+        self._log(("p", before_version, None))
+
+    def _apply_prune(self, before_version):
+        for k in list(self._prunable):
+            chain = self._chains.get(k)
+            if chain is None:
+                self._prunable.discard(k)
+                continue
             base_idx = -1
             for i, (v, _) in enumerate(chain):
                 if v <= before_version:
@@ -384,74 +420,37 @@ class KeyValueStoreVersioned:
                     break
             if base_idx > 0:
                 del chain[:base_idx]
-            # a tombstone base below the horizon can drop entirely
-            if len(chain) == 1 and chain[0][0] <= before_version and chain[0][1] is None:
-                dead.append(k)
-        for k in dead:
-            del self._chains[k]
+            if len(chain) == 1:
+                if chain[0][0] <= before_version and chain[0][1] is None:
+                    # a tombstone base below the horizon drops entirely
+                    del self._chains[k]
+                    self._prunable.discard(k)
+                elif chain[0][1] is not None:
+                    self._prunable.discard(k)  # nothing left to prune
         self._oldest = before_version
-        self._log(("p", before_version, None))
 
-    # ── durability plumbing (same framing as KeyValueStoreMemory) ──
-    def _log(self, op):
-        if self._wal is None:
-            return
-        payload = pickle.dumps(op, protocol=4)
-        self._wal.write(struct.pack(">II", len(payload), zlib.crc32(payload)) + payload)
-        self._ops_since_snapshot += 1
+    # ── WalEngineBase hooks ──
+    def _commit_version(self, version):
+        self._version = max(self._version, version)
 
-    def compact(self):
-        if self.path is None:
-            return
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(
-                (self._version, self._oldest, dict(self._chains)), f, protocol=4
-            )
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
-        if self._wal is not None:
-            self._wal.close()
-        self._wal = open(self._wal_path, "wb")
-        self._ops_since_snapshot = 0
+    def _snapshot_state(self):
+        return (self._version, self._oldest, dict(self._chains))
 
-    def _recover(self):
-        if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                self._version, self._oldest, chains = pickle.load(f)
-            self._chains = SortedDict(
-                {k: list(c) for k, c in chains.items()}
-            )
-        try:
-            with open(self._wal_path, "rb") as f:
-                raw = f.read()
-        except FileNotFoundError:
-            return
-        off = 0
-        while off + 8 <= len(raw):
-            ln, crc = struct.unpack_from(">II", raw, off)
-            if off + 8 + ln > len(raw):
-                break  # torn tail
-            payload = raw[off + 8 : off + 8 + ln]
-            if zlib.crc32(payload) != crc:
-                break
-            kind, a, b = pickle.loads(payload)
-            if kind == "sv":
-                version, value = b
-                chain = self._chains.setdefault(a, [])
-                if chain and chain[-1][0] == version:
-                    chain[-1] = (version, value)
-                else:
-                    chain.append((version, value))
-            elif kind == "v":
-                self._version = max(self._version, a)
-            elif kind == "p":
-                self.prune(a)  # _wal is still None here: no re-logging
-            off += 8 + ln
+    def _load_snapshot(self, state):
+        self._version, self._oldest, chains = state
+        self._chains = SortedDict({k: list(c) for k, c in chains.items()})
+        self._prunable = {
+            k for k, c in self._chains.items()
+            if len(c) > 1 or c[-1][1] is None
+        }
 
-    def close(self):
-        if self._wal is not None:
-            self._wal.flush()
-            self._wal.close()
-            self._wal = None
+    def _apply_record(self, kind, a, b):
+        if kind == "sv":
+            version, value = b
+            self._apply_set_versioned(a, version, value)
+        elif kind == "e":
+            for k in list(self._chains.irange(a, b, inclusive=(True, False))):
+                del self._chains[k]
+                self._prunable.discard(k)
+        elif kind == "p":
+            self._apply_prune(a)

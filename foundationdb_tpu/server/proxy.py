@@ -130,7 +130,11 @@ class CommitProxy:
             0, window - min(s.durable_version for s in self.storages)
         )
         for s in self.storages:
-            s.flush(window)
+            # a versioned (Redwood-role) engine keeps sub-durable reads
+            # serveable, so durability can run all the way to the latest
+            # version; single-version engines stop at the window floor or
+            # reads below the fold would silently lose history
+            s.flush(None if s.versioned_engine else window)
         self.tlog.pop(min(s.durable_version for s in self.storages))
         if self.ratekeeper is not None:
             self.ratekeeper.update(storage_lag_versions=lag)

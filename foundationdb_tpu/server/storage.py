@@ -132,8 +132,16 @@ class StorageServer(RangeReadInterface):
         # Single-threaded deployments pay one uncontended acquire per op.
         self._mu = threading.RLock()
         self.engine = engine if engine is not None else KeyValueStoreMemory()
+        # Versioned engines (the Redwood role, kvstore.KeyValueStoreVersioned)
+        # store per-key version chains, so the MVCC window extends into the
+        # durable tier: flush() writes every overlay version down instead of
+        # folding, and reads below durable_version stay serveable.
+        self.versioned_engine = bool(getattr(self.engine, "versioned", False))
         self.durable_version = self.engine.stored_version()
-        self.oldest_version = self.durable_version
+        if self.versioned_engine:
+            self.oldest_version = self.engine.oldest_retained
+        else:
+            self.oldest_version = self.durable_version
         self.version = self.durable_version  # latest applied
         self.window_versions = window_versions
         self._watches = {}  # key -> list[Watch]
@@ -214,10 +222,13 @@ class StorageServer(RangeReadInterface):
             keep = []
             for v, val in chain:
                 if v <= up_to_version:
+                    if self.versioned_engine:
+                        # Redwood-style: every version goes down intact
+                        self.engine.set_versioned(key, v, val)
                     folded = val
                 else:
                     keep.append((v, val))
-            if folded is not _MISS:
+            if folded is not _MISS and not self.versioned_engine:
                 if folded is None:
                     self.engine.clear_range(key, key_successor(key))
                 else:
@@ -228,9 +239,12 @@ class StorageServer(RangeReadInterface):
                 del self._overlay[key]
         self.engine.commit(up_to_version)
         self.durable_version = up_to_version
-        # reads below the durable version can no longer be served (the
-        # engine is single-version); keep the window invariant tight
-        self.oldest_version = max(self.oldest_version, up_to_version)
+        if not self.versioned_engine:
+            # reads below the durable version can no longer be served (the
+            # engine is single-version); keep the window invariant tight.
+            # A versioned engine keeps serving them from its chains, so its
+            # read floor moves only with advance_window (+ prune).
+            self.oldest_version = max(self.oldest_version, up_to_version)
         return self.durable_version
 
     # ───────────────────────────── reads ───────────────────────────────
@@ -252,6 +266,8 @@ class StorageServer(RangeReadInterface):
                     break
             if val is not _MISS:
                 return val
+        if self.versioned_engine:
+            return self.engine.get_at(key, version)
         return self.engine.get(key)
 
     def get(self, key, version):
@@ -284,7 +300,10 @@ class StorageServer(RangeReadInterface):
     def _iter_live_locked(self, begin, end, version, reverse=False):
         sentinel = object()
         ov = iter(self._overlay.irange(begin, end, inclusive=(True, False), reverse=reverse))
-        base = self.engine.iter_range(begin, end, reverse=reverse)
+        if self.versioned_engine:
+            base = self.engine.iter_range_at(begin, end, version, reverse=reverse)
+        else:
+            base = self.engine.iter_range(begin, end, reverse=reverse)
         ko = next(ov, sentinel)
         kb = next(base, sentinel)
         while ko is not sentinel or kb is not sentinel:
@@ -320,14 +339,20 @@ class StorageServer(RangeReadInterface):
         versions stay correct (ref: fetchKeys streaming + the mutation
         buffer that brings a joining storage up to date)."""
         with self._mu:
-            base = dict(self.engine.iter_range(begin, end))
+            if self.versioned_engine:
+                # the engine holds real history below durable_version —
+                # export it intact so the joiner can honor the same floor
+                base = {k: c for k, c in self.engine.iter_chains(begin, end)}
+            else:
+                base = {
+                    k: [(self.durable_version, v)]
+                    for k, v in self.engine.iter_range(begin, end)
+                }
             keys = set(base)
             keys.update(self._overlay.irange(begin, end, inclusive=(True, False)))
             rows = []
             for k in sorted(keys):
-                chain = []
-                if k in base:
-                    chain.append((self.durable_version, base[k]))
+                chain = list(base.get(k, ()))
                 chain.extend(self._overlay.get(k, ()))
                 rows.append((k, chain))
             return (self.oldest_version, self.version, rows)
@@ -344,7 +369,15 @@ class StorageServer(RangeReadInterface):
         with self._mu:
             self.version = max(self.version, version)
             self.oldest_version = max(self.oldest_version, oldest)
-            self.engine.clear_range(begin, end)
+            if self.versioned_engine:
+                # physically evict any stale pre-move history: a clear
+                # would tombstone at the durable version, and the later
+                # flush of the ingested (lower-version) chain entries
+                # would land AFTER it, corrupting the ascending-order
+                # invariant chains rely on
+                self.engine.erase_range(begin, end)
+            else:
+                self.engine.clear_range(begin, end)
             for k in list(self._overlay.irange(begin, end, inclusive=(True, False))):
                 del self._overlay[k]
             for k, chain in rows:
@@ -381,6 +414,14 @@ class StorageServer(RangeReadInterface):
         durability pump owns flushing (ref: the storage server's
         updateStorage loop being a separate actor from version updates),
         so the pump can observe real durability lag and feed it to the
-        ratekeeper instead of hiding it behind a per-batch flush."""
-        self.oldest_version = max(self.oldest_version, oldest)
+        ratekeeper instead of hiding it behind a per-batch flush.
+
+        With a versioned engine the floor also garbage-collects: history
+        below it is unreachable, so the engine prunes its chains (ref:
+        Redwood trimming page versions that left the MVCC window)."""
+        if oldest > self.oldest_version:
+            self.oldest_version = oldest
+            if self.versioned_engine:
+                with self._mu:
+                    self.engine.prune(min(oldest, self.durable_version))
 

@@ -19,7 +19,7 @@ import tempfile
 
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.server.cluster import Cluster
-from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
+from foundationdb_tpu.server.kvstore import open_engine
 from foundationdb_tpu.sim.buggify import Buggify
 
 
@@ -100,8 +100,9 @@ class Simulation:
     SIM_DT = 0.001
 
     def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
-                 datadir=None, **cluster_kwargs):
+                 datadir=None, engine="memory", **cluster_kwargs):
         self.seed = seed
+        self.engine_kind = engine  # "memory" | "versioned" | "sqlite"
         self.rng = random.Random(seed)
         self.buggify = Buggify(seed=seed, enabled=buggify)
         self.crash_p = crash_p
@@ -134,7 +135,7 @@ class Simulation:
         global_trace_log().clock = lambda: self.steps
         self.cluster = Cluster(
             wal_path=self._wal_path,
-            storage_engines=[KeyValueStoreMemory(self._store_path)],
+            storage_engines=[open_engine(self.engine_kind, self._store_path)],
             n_resolvers=self.n_resolvers,
             # coordinators persist beside the WAL so crash_and_recover
             # exercises the real quorum-locking recovery path

@@ -242,3 +242,238 @@ def test_storage_differential_vs_dict_oracle():
             ss.flush(v - rng.randrange(0, 3))
         got = dict(ss.get_range(b"", b"\xff", ss.version))
         assert got == oracle, f"divergence at version {v}"
+
+
+# ──────────────── versioned engine (the Redwood role) ───────────────────
+def test_versioned_engine_chains_and_prune(tmp_path):
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    e = KeyValueStoreVersioned(str(tmp_path / "v"))
+    e.set_versioned(b"a", 10, b"1")
+    e.set_versioned(b"a", 20, b"2")
+    e.set_versioned(b"a", 30, None)  # tombstone
+    e.set_versioned(b"b", 20, b"b2")
+    e.commit(30)
+    assert e.get_at(b"a", 15) == b"1"
+    assert e.get_at(b"a", 25) == b"2"
+    assert e.get_at(b"a", 35) is None
+    assert e.get_at(b"a", 5) is None  # before first write
+    assert list(e.iter_range_at(b"", b"\xff", 20)) == [(b"a", b"2"), (b"b", b"b2")]
+    assert list(e.iter_range_at(b"", b"\xff", 31)) == [(b"b", b"b2")]
+    # prune keeps the base every admissible read needs
+    e.prune(20)
+    assert e.get_at(b"a", 20) == b"2"
+    assert e.get_at(b"a", 35) is None
+    # a tombstone base below the horizon drops the whole chain
+    e.prune(31)
+    assert e.get_at(b"a", 35) is None
+    assert list(e.iter_chains(b"a", b"a\x00")) == []
+    e.close()
+
+
+def test_versioned_engine_recovery(tmp_path):
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    path = str(tmp_path / "v")
+    e = KeyValueStoreVersioned(path)
+    for v in (10, 20, 30):
+        e.set_versioned(b"k", v, b"%d" % v)
+    e.prune(10)
+    e.commit(30)
+    e.compact()
+    e.set_versioned(b"k", 40, b"40")
+    e.commit(40)
+    e.close()
+    e2 = KeyValueStoreVersioned(path)
+    assert e2.stored_version() == 40
+    assert e2.oldest_retained == 10
+    for v, want in ((10, b"10"), (25, b"20"), (35, b"30"), (45, b"40")):
+        assert e2.get_at(b"k", v) == want, v
+    e2.close()
+
+
+def test_storage_versioned_engine_serves_subdurable_reads(tmp_path):
+    """The integration contract: with a versioned engine the durability
+    frontier runs ahead of the read floor — reads BELOW durable_version
+    still serve from engine history (ref: Redwood extending the MVCC
+    window into the durable tier)."""
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    ss = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "v")))
+    assert ss.versioned_engine
+    ss.apply(10, [_set(b"a", b"1"), _set(b"b", b"x")])
+    ss.apply(20, [_set(b"a", b"2"), _clr(b"b", b"c")])
+    ss.apply(30, [_set(b"a", b"3")])
+    ss.flush()  # ALL versions go durable
+    assert ss.durable_version == 30
+    assert ss._overlay == {}
+    assert ss.oldest_version == 0  # floor did NOT jump with durability
+    # point reads below the durable version
+    assert ss.get(b"a", 10) == b"1"
+    assert ss.get(b"a", 25) == b"2"
+    assert ss.get(b"b", 15) == b"x"
+    assert ss.get(b"b", 25) is None
+    # range reads below the durable version
+    assert ss.get_range(b"", b"\xff", 15) == [(b"a", b"1"), (b"b", b"x")]
+    assert ss.get_range(b"", b"\xff", 30) == [(b"a", b"3")]
+    # selector walk at a historical version
+    assert ss.resolve_selector(KeySelector.first_greater_than(b"a"), 15) == b"b"
+    # the floor still advances by policy, pruning history
+    ss.advance_window(20)
+    with pytest.raises(FDBError):
+        ss.get(b"a", 15)
+    assert ss.get(b"a", 25) == b"2"  # >= floor still fine
+
+
+def test_storage_versioned_mixed_tier_reads(tmp_path):
+    """Reads merge overlay (undurable) over engine history correctly."""
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    ss = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "v")))
+    ss.apply(10, [_set(b"a", b"1"), _set(b"c", b"c1")])
+    ss.flush(10)
+    ss.apply(20, [_set(b"b", b"2"), _set(b"a", b"1.1")])  # overlay only
+    assert ss.get_range(b"", b"\xff", 20) == [
+        (b"a", b"1.1"), (b"b", b"2"), (b"c", b"c1")
+    ]
+    assert ss.get_range(b"", b"\xff", 10) == [(b"a", b"1"), (b"c", b"c1")]
+    assert ss.get(b"a", 10) == b"1"
+
+
+def test_storage_versioned_differential_history_oracle(tmp_path):
+    """Randomized sets/clears/flushes vs a full version-history oracle:
+    every read at every version >= the floor must match, across flush
+    boundaries (the single-version engines can only check latest)."""
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    rng = random.Random(11)
+    ss = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "v")))
+    history = {}  # version -> snapshot dict
+    snap = {}
+    v = 0
+    keys = [b"k%02d" % i for i in range(12)]
+    for _ in range(120):
+        v += 1
+        op = rng.random()
+        if op < 0.55:
+            k = rng.choice(keys)
+            val = b"v%d" % rng.randrange(1000)
+            ss.apply(v, [_set(k, val)])
+            snap[k] = val
+        elif op < 0.75:
+            b, e = sorted(rng.sample(keys, 2))
+            ss.apply(v, [_clr(b, e)])
+            for k in list(snap):
+                if b <= k < e:
+                    del snap[k]
+        else:
+            ss.apply(v, [])
+            if rng.random() < 0.5:
+                ss.flush(v - rng.randrange(0, 4))
+        history[v] = dict(snap)
+    ss.flush()
+    for rv in range(1, v + 1):
+        got = dict(ss.get_range(b"", b"\xff", rv))
+        assert got == history[rv], f"divergence at read version {rv}"
+
+
+def test_storage_versioned_export_ingest_preserves_history(tmp_path):
+    """Shard export from a versioned storage carries engine-held history,
+    so the joiner serves the same sub-durable snapshots as the source."""
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    src = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "src")))
+    src.apply(10, [_set(b"m", b"1")])
+    src.apply(20, [_set(b"m", b"2")])
+    src.flush()  # history lives in the ENGINE now
+    src.apply(30, [_set(b"m", b"3")])  # and a bit in the overlay
+    dst = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "dst")))
+    for v in (10, 20, 30):
+        dst.apply(v, [])  # version-synced replica
+    dst.ingest_shard(b"m", b"n", src.export_shard(b"m", b"n"))
+    assert dst.get(b"m", 15) == b"1"
+    assert dst.get(b"m", 25) == b"2"
+    assert dst.get(b"m", 30) == b"3"
+
+
+def test_cluster_versioned_engine_end_to_end(tmp_path):
+    """Cluster on the versioned engine: commits, aggressive durability,
+    reads at old versions, crash/restart recovery."""
+    from foundationdb_tpu.server.cluster import Cluster
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    wal = str(tmp_path / "wal")
+    eng = str(tmp_path / "store")
+    c1 = Cluster(wal_path=wal,
+                 storage_engines=[KeyValueStoreVersioned(eng)],
+                 resolver_backend="cpu")
+    c1.commit_proxy.pump_interval = 2  # pump (flush-to-latest) often
+    db1 = c1.database()
+    tr = db1.create_transaction()
+    db1[b"a"] = b"1"
+    rv_old = tr.get_read_version()
+    for i in range(10):
+        db1[b"k%d" % i] = b"v"
+    db1[b"a"] = b"2"
+    # the pump has flushed past rv_old; the versioned engine still serves it
+    assert c1.storage.durable_version > rv_old
+    assert tr.get(b"a", snapshot=True) == b"1"
+    c1.storage.engine.close()
+    c1.tlog.close()
+    c2 = Cluster(wal_path=wal,
+                 storage_engines=[KeyValueStoreVersioned(eng)],
+                 resolver_backend="cpu")
+    db2 = c2.database()
+    assert db2[b"a"] == b"2"
+    assert all(db2[b"k%d" % i] == b"v" for i in range(10))
+    db2[b"post"] = b"x"
+    assert db2[b"post"] == b"x"
+
+
+def test_versioned_ingest_over_stale_copy_no_chain_corruption(tmp_path):
+    """Regression (round-2 review, confirmed by execution): ingesting a
+    shard onto a versioned storage that already held keys in the range
+    durably must physically erase the stale copy. A clear_range would
+    tombstone at the dst durable version and the next flush would append
+    the ingested chain's LOWER versions after it, breaking the ascending
+    invariant — reads then silently return wrong values."""
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    src = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "s")))
+    src.apply(5, [_set(b"m", b"x")])
+    src.apply(20, [_set(b"m", b"y")])
+
+    dst = StorageServer(engine=KeyValueStoreVersioned(str(tmp_path / "d")))
+    dst.apply(50, [_set(b"m", b"stale")])
+    dst.flush()  # stale copy durable at 50
+    dst.ingest_shard(b"m", b"n", src.export_shard(b"m", b"n"))
+    assert dst.get(b"m", 25) == b"y"
+    assert dst.get(b"m", 10) == b"x"
+    assert dst.get(b"m", 50) == b"y"
+    # the next durability round flushes the ingested history down;
+    # the engine chain must come out ascending, reads unchanged
+    dst.apply(60, [_set(b"m", b"z")])
+    dst.flush()
+    assert dst._overlay == {}
+    chains = dict(dst.engine.iter_chains(b"m", b"n"))
+    vs = [v for v, _ in chains[b"m"]]
+    assert vs == sorted(vs) == [5, 20, 60], vs
+    assert dst.get(b"m", 25) == b"y"
+    assert dst.get(b"m", 10) == b"x"
+    assert dst.get(b"m", 60) == b"z"
+
+
+def test_versioned_erase_range_durable(tmp_path):
+    from foundationdb_tpu.server.kvstore import KeyValueStoreVersioned
+
+    path = str(tmp_path / "v")
+    e = KeyValueStoreVersioned(path)
+    e.set_versioned(b"a", 10, b"1")
+    e.set_versioned(b"b", 10, b"1")
+    e.erase_range(b"a", b"b")
+    e.commit(10)
+    e.close()
+    e2 = KeyValueStoreVersioned(path)
+    assert e2.get_at(b"a", 10) is None
+    assert e2.get_at(b"b", 10) == b"1"
+    e2.close()

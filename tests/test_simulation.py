@@ -20,10 +20,10 @@ from foundationdb_tpu.sim.workloads import (
 )
 
 
-def _run_cycle_sim(seed, tmp_path, buggify=True, crash_p=0.004):
+def _run_cycle_sim(seed, tmp_path, buggify=True, crash_p=0.004, **kw):
     sim = Simulation(
         seed=seed, buggify=buggify, crash_p=crash_p,
-        datadir=str(tmp_path / f"sim{seed}"),
+        datadir=str(tmp_path / f"sim{seed}"), **kw,
     )
     n_nodes = 20
     cycle_setup(sim.db, n_nodes)
@@ -53,6 +53,20 @@ def test_cycle_invariant_and_faults_across_seeds(tmp_path):
             recoveries += sim.recoveries
     assert sites, "no buggify site ever activated across seeds"
     assert recoveries > 0, "no crash/recovery ever exercised across seeds"
+
+
+def test_cycle_on_versioned_engine_under_faults(tmp_path):
+    """The Redwood-role engine under the full fault battery: buggify +
+    crash/recovery with the storage tier flushing every version durable
+    and serving sub-durable reads (ref: simulation runs over each
+    storage engine type)."""
+    recoveries = 0
+    for seed in (3, 4):
+        with _run_cycle_sim(seed, tmp_path, engine="versioned",
+                            crash_p=0.01) as sim:
+            recoveries += sim.recoveries
+            assert sim.cluster.storage.versioned_engine
+    assert recoveries > 0, "no crash/recovery exercised on versioned engine"
 
 
 @pytest.mark.parametrize("seed", [11, 12, 13])
