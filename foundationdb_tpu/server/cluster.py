@@ -24,7 +24,7 @@ from foundationdb_tpu.server.ratekeeper import Ratekeeper
 from foundationdb_tpu.server.router import StorageRouter
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
-from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.server.tlog import TLog, TLogSystem
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
@@ -34,7 +34,7 @@ class Cluster:
                  coordination=None, n_coordinators=3, coordination_dir=None,
                  replication=None, commit_pipeline="sync",
                  commit_batch_max=None, commit_flush_after=4,
-                 target_tps=None, rk_clock=None,
+                 target_tps=None, rk_clock=None, n_tlogs=1,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -70,7 +70,14 @@ class Cluster:
         # with their window starting at the recovered version, so any
         # read version from before the crash is rejected TOO_OLD — the
         # same effect as the reference's recovery fencing in-flight txns.
-        recovered_records = TLog.recover(wal_path) if wal_path else []
+        # replicated logs recover from the union of surviving replica WALs
+        # (ref: recovery reading a quorum of the old tlog generation)
+        if wal_path and n_tlogs > 1:
+            recovered_records = TLogSystem.recover(wal_path, n_tlogs)
+        elif wal_path:
+            recovered_records = TLog.recover(wal_path)
+        else:
+            recovered_records = []
         for s in self.storages:
             for version, mutations in recovered_records:
                 if version > s.version:
@@ -102,7 +109,10 @@ class Cluster:
         TraceEvent("MasterRecovered").detail(
             generation=self.generation, version=recovered).log()
 
-        self.tlog = TLog(wal_path=wal_path)
+        if n_tlogs > 1:
+            self.tlog = TLogSystem(n_tlogs, wal_path=wal_path)
+        else:
+            self.tlog = TLog(wal_path=wal_path)
         self.tlog._first_version = recovered
         self.sequencer = Sequencer(
             version_clock=version_clock, start_version=recovered

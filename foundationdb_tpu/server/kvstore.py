@@ -391,10 +391,13 @@ class KeyValueStoreVersioned(WalEngineBase):
         shard ingest uses it to evict a stale pre-move copy so the
         source's authoritative history can be installed without
         interleaving out-of-order versions into surviving chains."""
+        self._apply_erase(begin, end)
+        self._log(("e", begin, end))
+
+    def _apply_erase(self, begin, end):
         for k in list(self._chains.irange(begin, end, inclusive=(True, False))):
             del self._chains[k]
             self._prunable.discard(k)
-        self._log(("e", begin, end))
 
     def prune(self, before_version):
         """Drop history below ``before_version``: each chain keeps its
@@ -449,8 +452,6 @@ class KeyValueStoreVersioned(WalEngineBase):
             version, value = b
             self._apply_set_versioned(a, version, value)
         elif kind == "e":
-            for k in list(self._chains.irange(a, b, inclusive=(True, False))):
-                del self._chains[k]
-                self._prunable.discard(k)
+            self._apply_erase(a, b)
         elif kind == "p":
             self._apply_prune(a)

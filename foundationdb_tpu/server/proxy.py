@@ -12,6 +12,7 @@ from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
 from foundationdb_tpu.resolver.skiplist import TxnRequest
+from foundationdb_tpu.server.tlog import TLogDown
 
 
 class CommitRequest:
@@ -105,7 +106,22 @@ class CommitProxy:
                     )
 
         # push even empty batches so storage's version advances with cv
-        self.tlog.push(cv, batch_mutations)
+        try:
+            self.tlog.push(cv, batch_mutations)
+        except TLogDown:
+            # no durability quorum: the would-be-committed txns are in
+            # limbo → honest 1021, nothing applied to storage (ref:
+            # proxies dying with an unacked tlog push). Definitive
+            # resolver rejections (not_committed / too_old) stand —
+            # those clients may retry without 1021 disambiguation.
+            self.commit_count -= sum(
+                1 for r in results if not isinstance(r, FDBError)
+            )
+            return [
+                r if isinstance(r, FDBError)
+                else FDBError.from_name("commit_unknown_result")
+                for r in results
+            ]
         for sid, muts in enumerate(self._route(batch_mutations)):
             self.storages[sid].apply(cv, muts)
             self.storages[sid].advance_window(window)

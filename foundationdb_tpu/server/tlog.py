@@ -4,6 +4,11 @@ Ref parity: fdbserver/TLogServer.actor.cpp — commit proxies push
 version-ordered mutation batches; storage servers peek from their durable
 version and pop when applied. Durability here is an optional append-only
 file WAL with length-framed records (the reference fsyncs a DiskQueue).
+
+``TLogSystem`` is the replicated tier (ref: TagPartitionedLogSystem):
+k TLog replicas, a push is acked once a quorum made it durable, peeks
+merge across live replicas, and recovery unions the surviving WALs —
+losing a minority of logs loses no acked commit.
 """
 
 import os
@@ -12,16 +17,23 @@ import struct
 import zlib
 
 
+class TLogDown(Exception):
+    """This log replica is dead (simulation kill or process loss)."""
+
+
 class TLog:
     def __init__(self, wal_path=None, fsync=False):
         self._log = []  # list[(version, mutations)]
         self._first_version = 0
         self.wal_path = wal_path
         self.fsync = fsync
+        self.alive = True
         self._wal = open(wal_path, "ab") if wal_path else None
         self._pop_holds = {}  # name -> version: keep records > version
 
     def push(self, version, mutations):
+        if not self.alive:
+            raise TLogDown()
         if self._log and version <= self._log[-1][0]:
             raise ValueError("tlog push out of order")
         self._log.append((version, mutations))
@@ -33,8 +45,30 @@ class TLog:
             if self.fsync:
                 os.fsync(self._wal.fileno())
 
+    def rollback(self, version):
+        """Undo a just-pushed tail record that failed to reach its
+        replication quorum: drop it from the live log and append an
+        abort marker so WAL recovery drops it too. Without this, a
+        record on a minority of replicas materializes at recovery AFTER
+        later commits were applied without it — a consistency anomaly,
+        not just the legal 1021 ambiguity."""
+        if not self.alive:
+            raise TLogDown()
+        if self._log and self._log[-1][0] == version:
+            self._log.pop()
+            if self._wal is not None:
+                payload = pickle.dumps(("abort", version), protocol=4)
+                self._wal.write(
+                    struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
+                )
+                self._wal.flush()
+                if self.fsync:
+                    os.fsync(self._wal.fileno())
+
     def peek(self, from_version):
         """All records with version > from_version, in order."""
+        if not self.alive:
+            raise TLogDown()
         return [(v, m) for v, m in self._log if v > from_version]
 
     def hold_pop(self, name, version):
@@ -68,6 +102,7 @@ class TLog:
         """Replay a WAL file → list[(version, mutations)], tolerating a
         torn tail (ref: DiskQueue recovery)."""
         out = []
+        aborted = set()
         try:
             with open(wal_path, "rb") as f:
                 data = f.read()
@@ -81,6 +116,140 @@ class TLog:
             payload = data[off + 8 : off + 8 + ln]
             if zlib.crc32(payload) != crc:
                 break
-            out.append(pickle.loads(payload))
+            rec = pickle.loads(payload)
+            if rec[0] == "abort":  # quorum-failure rollback marker
+                aborted.add(rec[1])
+            else:
+                out.append(rec)
             off += 8 + ln
+        if aborted:
+            out = [r for r in out if r[0] not in aborted]
         return out
+
+
+class TLogSystem:
+    """k replicated TLogs with quorum-acked pushes.
+
+    Ref parity: TagPartitionedLogSystem — the proxy's push is durable
+    once enough replicas logged it; the chosen quorum (majority by
+    default) means any surviving majority holds every acked commit, so
+    recovery (union of surviving WALs) loses nothing when a minority of
+    logs dies. Exposes the single-TLog interface, so the proxy, backup
+    agent, and storage recovery are replication-agnostic.
+    """
+
+    def __init__(self, n=3, wal_path=None, fsync=False, quorum=None):
+        self.n = n
+        self.quorum = quorum if quorum is not None else n // 2 + 1
+        self.wal_path = wal_path  # base path; replica i appends .i
+        self.logs = [
+            TLog(wal_path=f"{wal_path}.{i}" if wal_path else None, fsync=fsync)
+            for i in range(n)
+        ]
+        self._pop_holds = {}
+
+    @staticmethod
+    def replica_paths(wal_path, n):
+        return [f"{wal_path}.{i}" for i in range(n)]
+
+    # ── replica lifecycle (simulation / failure detection hooks) ──
+    def kill(self, i):
+        self.logs[i].alive = False
+
+    def revive(self, i):
+        """A rebooted replica rejoins empty-caught-up: it copies a live
+        peer's suffix (ref: a new tlog generation starting from the
+        recovery version, not the reference's exact mechanism)."""
+        log = self.logs[i]
+        log.alive = True
+        log._log = []
+        donor = next((l for l in self.logs if l.alive and l is not log), None)
+        if donor is not None:
+            log._first_version = donor._first_version
+            for v, m in donor.peek(0):
+                log.push(v, m)
+        return log
+
+    @property
+    def live_count(self):
+        return sum(1 for l in self.logs if l.alive)
+
+    # ── single-TLog facade ──
+    @property
+    def _first_version(self):
+        return min(l._first_version for l in self.logs if l.alive)
+
+    @_first_version.setter
+    def _first_version(self, v):
+        for l in self.logs:
+            l._first_version = v
+
+    def push(self, version, mutations):
+        """Replicate to every live log; durable at ``quorum`` acks.
+        Raises TLogDown when a quorum is unreachable — the partial
+        replicas roll the record back (abort-marked in their WALs) so it
+        cannot resurface at recovery after later commits landed without
+        it; the proxy turns the failure into commit_unknown_result."""
+        accepted = []
+        for log in self.logs:
+            try:
+                log.push(version, mutations)
+                accepted.append(log)
+            except TLogDown:
+                continue
+        if len(accepted) < self.quorum:
+            for log in accepted:  # best-effort undo of the partial push
+                try:
+                    log.rollback(version)
+                except TLogDown:
+                    pass
+            raise TLogDown(
+                f"{len(accepted)}/{self.n} tlogs acked (need {self.quorum})"
+            )
+
+    def peek(self, from_version):
+        """Merged view across live replicas: the union of their records
+        (any acked record is on ≥ quorum of them; a dead replica's gaps
+        are covered by the others)."""
+        merged = {}
+        for log in self.logs:
+            if not log.alive:
+                continue
+            for v, m in log.peek(from_version):
+                merged.setdefault(v, m)
+        return sorted(merged.items())
+
+    def hold_pop(self, name, version):
+        self._pop_holds[name] = version
+        for log in self.logs:
+            log.hold_pop(name, version)
+
+    def release_pop(self, name):
+        self._pop_holds.pop(name, None)
+        for log in self.logs:
+            log.release_pop(name)
+
+    def pop(self, up_to_version):
+        for log in self.logs:
+            if log.alive:
+                log.pop(up_to_version)
+
+    @property
+    def last_version(self):
+        return max(l.last_version for l in self.logs if l.alive)
+
+    def close(self):
+        for log in self.logs:
+            log.close()
+
+    @classmethod
+    def recover(cls, wal_path, n):
+        """Union the surviving replica WALs → list[(version, mutations)].
+        Any record acked at quorum survives the loss of a minority; a
+        record present on only a minority was never acked (its client saw
+        commit_unknown_result) — including it is the legal 1021 outcome."""
+        merged = {}
+        for path in cls.replica_paths(wal_path, n):
+            for v, m in TLog.recover(path):
+                merged.setdefault(v, m)
+        return sorted(merged.items())

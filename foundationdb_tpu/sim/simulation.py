@@ -20,6 +20,7 @@ import tempfile
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.server.cluster import Cluster
 from foundationdb_tpu.server.kvstore import open_engine
+from foundationdb_tpu.server.tlog import TLogSystem
 from foundationdb_tpu.sim.buggify import Buggify
 
 
@@ -188,6 +189,7 @@ class Simulation:
                 raise RuntimeError(f"simulation exceeded {max_steps} steps")
             if self.crash_p and self.buggify("cluster_crash", fire_p=self.crash_p):
                 self.crash_and_recover()
+            self._maybe_fault_tlogs()
             i = self.rng.randrange(len(live))
             self.schedule_hash = (self.schedule_hash * 1000003 + i) & (2**64 - 1)
             name, gen = live[i]
@@ -200,6 +202,23 @@ class Simulation:
             if self._pump is not None:
                 self._pump(self.steps)
         self._actors = []
+
+    def _maybe_fault_tlogs(self):
+        """Replicated-log fault sites: kill a live tlog replica (never
+        below the ack quorum, so the cluster keeps committing with a
+        degraded log tier) and revive dead ones caught-up-from-a-peer
+        (ref: sim2 killing individual processes, not whole clusters)."""
+        tl = self.cluster.tlog
+        if not isinstance(tl, TLogSystem):
+            return
+        self.tlog_kills = getattr(self, "tlog_kills", 0)
+        if tl.live_count > tl.quorum and self.buggify("tlog_kill", fire_p=0.004):
+            live = [i for i, l in enumerate(tl.logs) if l.alive]
+            tl.kill(self.rng.choice(live))
+            self.tlog_kills += 1
+        dead = [i for i, l in enumerate(tl.logs) if not l.alive]
+        if dead and self.buggify("tlog_revive", fire_p=0.01):
+            tl.revive(self.rng.choice(dead))
 
     def quiesce(self):
         """Flush storage so everything is durable (end-of-run barrier)."""

@@ -1,0 +1,153 @@
+"""Replicated transaction logs: quorum pushes, merged peeks, minority
+loss without data loss (ref: TagPartitionedLogSystem +
+TLogServer.actor.cpp's durability contract)."""
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.mutations import Mutation, Op
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.server.tlog import TLog, TLogDown, TLogSystem
+from tests.conftest import TEST_KNOBS
+
+
+def _set(k, v):
+    return Mutation(Op.SET, k, v)
+
+
+class TestTLogSystem:
+    def test_push_peek_pop_replicated(self, tmp_path):
+        ts = TLogSystem(3, wal_path=str(tmp_path / "w"))
+        for v in (10, 20, 30):
+            ts.push(v, [_set(b"k", b"%d" % v)])
+        assert [v for v, _ in ts.peek(0)] == [10, 20, 30]
+        assert all(len(l.peek(0)) == 3 for l in ts.logs)
+        ts.pop(20)
+        assert [v for v, _ in ts.peek(0)] == [30]
+        assert ts.last_version == 30
+        ts.close()
+
+    def test_minority_death_keeps_acking_and_peeking(self, tmp_path):
+        ts = TLogSystem(3, wal_path=str(tmp_path / "w"))
+        ts.push(10, [_set(b"a", b"1")])
+        ts.kill(0)
+        ts.push(20, [_set(b"b", b"2")])  # 2/3 acks: fine
+        assert [v for v, _ in ts.peek(0)] == [10, 20]
+        ts.close()
+
+    def test_quorum_loss_raises(self, tmp_path):
+        ts = TLogSystem(3, wal_path=str(tmp_path / "w"))
+        ts.kill(0)
+        ts.kill(1)
+        with pytest.raises(TLogDown):
+            ts.push(10, [_set(b"a", b"1")])
+        ts.close()
+
+    def test_revive_catches_up_from_peer(self, tmp_path):
+        ts = TLogSystem(3, wal_path=str(tmp_path / "w"))
+        ts.push(10, [_set(b"a", b"1")])
+        ts.kill(2)
+        ts.push(20, [_set(b"b", b"2")])
+        ts.revive(2)
+        assert [v for v, _ in ts.logs[2].peek(0)] == [10, 20]
+        ts.kill(0)
+        ts.kill(1)  # the revived replica alone holds the merged view
+        assert [v for v, _ in ts.peek(0)] == [10, 20]
+        ts.close()
+
+    def test_recover_unions_surviving_wals(self, tmp_path):
+        base = str(tmp_path / "w")
+        ts = TLogSystem(3, wal_path=base)
+        ts.push(10, [_set(b"a", b"1")])
+        ts.kill(0)  # replica 0's WAL stops at version 10
+        ts.push(20, [_set(b"b", b"2")])
+        ts.close()
+        records = TLogSystem.recover(base, 3)
+        assert [v for v, _ in records] == [10, 20]
+
+
+class TestClusterReplicatedLogs:
+    def test_kill_one_of_three_no_data_loss(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        c1 = Cluster(wal_path=wal, n_tlogs=3, **TEST_KNOBS)
+        db1 = c1.database()
+        db1[b"pre"] = b"1"
+        c1.tlog.kill(0)
+        for i in range(5):
+            db1[b"k%d" % i] = b"v"  # committed on a 2/3 quorum
+        c1.tlog.close()
+        # restart: union of surviving WALs recovers everything acked
+        c2 = Cluster(wal_path=wal, n_tlogs=3, **TEST_KNOBS)
+        db2 = c2.database()
+        assert db2[b"pre"] == b"1"
+        for i in range(5):
+            assert db2[b"k%d" % i] == b"v", i
+        db2[b"post"] = b"x"
+        assert db2[b"post"] == b"x"
+
+    def test_quorum_loss_yields_1021_not_applied(self, tmp_path):
+        c = Cluster(wal_path=str(tmp_path / "wal"), n_tlogs=3, **TEST_KNOBS)
+        db = c.database()
+        db[b"a"] = b"1"
+        c.tlog.kill(0)
+        c.tlog.kill(1)
+        tr = db.create_transaction()
+        tr.set(b"limbo", b"x")
+        with pytest.raises(FDBError) as ei:
+            tr.commit()
+        assert ei.value.code == 1021
+        # not applied to storage, and the cluster heals on revive
+        c.tlog.revive(0)
+        assert db[b"limbo"] is None
+        db[b"limbo"] = b"y"
+        assert db[b"limbo"] == b"y"
+
+
+def test_sim_cycle_with_tlog_kills(tmp_path):
+    """Cycle invariant holds while individual tlog replicas die and
+    rejoin mid-workload, plus whole-cluster crashes on top."""
+    import random
+
+    from foundationdb_tpu.sim.simulation import Simulation
+    from foundationdb_tpu.sim.workloads import (
+        cycle_check, cycle_setup, cycle_workload,
+    )
+
+    kills = 0
+    for seed in (1, 3, 4):
+        sim = Simulation(seed=seed, crash_p=0.004, n_tlogs=3,
+                         datadir=str(tmp_path / f"s{seed}"))
+        cycle_setup(sim.db, 16)
+        for a in range(3):
+            rng = random.Random(seed * 31 + a)
+            sim.add_workload(f"c{a}", cycle_workload(sim.db, 16, 25, rng))
+        sim.run()
+        sim.quiesce()
+        cycle_check(sim.db, 16)
+        kills += getattr(sim, "tlog_kills", 0)
+        sim.close()
+    assert kills > 0, "no tlog replica was ever killed across seeds"
+
+
+def test_quorum_failed_push_rolled_back_never_resurrects(tmp_path):
+    """A record that failed its replication quorum is abort-marked on the
+    partial replicas: recovery must NOT replay it after later commits
+    were applied without it (that would be a consistency anomaly, beyond
+    the legal 1021 ambiguity)."""
+    wal = str(tmp_path / "wal")
+    c = Cluster(wal_path=wal, n_tlogs=3, **TEST_KNOBS)
+    db = c.database()
+    db[b"a"] = b"1"
+    c.tlog.kill(0)
+    c.tlog.kill(1)
+    tr = db.create_transaction()
+    tr.set(b"limbo", b"x")
+    with pytest.raises(FDBError):
+        tr.commit()  # partial push on replica 2, rolled back
+    c.tlog.revive(0)
+    db[b"later"] = b"y"  # commits resume past the aborted version
+    c.tlog.close()
+    c2 = Cluster(wal_path=wal, n_tlogs=3, **TEST_KNOBS)
+    db2 = c2.database()
+    assert db2[b"limbo"] is None
+    assert db2[b"a"] == b"1" and db2[b"later"] == b"y"
