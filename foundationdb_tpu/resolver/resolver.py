@@ -22,11 +22,17 @@ from foundationdb_tpu.resolver.skiplist import CpuConflictSet
 COMMITTED, CONFLICT, TOO_OLD = ck.COMMITTED, ck.CONFLICT, ck.TOO_OLD
 
 
+class ResolverDown(Exception):
+    """This resolver process is dead; the proxy fails the batch
+    not_committed and the cluster controller recruits a replacement."""
+
+
 class Resolver:
     def __init__(self, knobs=DEFAULT_KNOBS, base_version=0):
         self.knobs = knobs
         self.backend = knobs.resolver_backend
         self.base_version = base_version
+        self.alive = True
         if self.backend == "tpu":
             self.params = ck.ResolverParams(
                 txns=knobs.batch_txn_capacity,
@@ -55,8 +61,16 @@ class Resolver:
         else:
             raise ValueError(f"unknown resolver_backend {self.backend!r}")
 
+    def kill(self):
+        """Process death: in-memory conflict history is gone; the
+        replacement must fence pre-death read versions (ref: resolver
+        failure forcing a recovery in the reference)."""
+        self.alive = False
+
     def resolve(self, txns, commit_version, new_window_start):
         """txns: list[TxnRequest] in arrival order → list of statuses."""
+        if not self.alive:
+            raise ResolverDown()
         if self.backend in ("cpu", "native"):
             return self.cset.resolve(txns, commit_version, new_window_start)
         self._maybe_rebase(commit_version)

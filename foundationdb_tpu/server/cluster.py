@@ -143,6 +143,7 @@ class Cluster:
         # "manual": deterministic batching driven by the sim scheduler.
         # "sync": 1-txn batches, the degenerate pipeline.
         self.commit_pipeline = commit_pipeline
+        self.recruitments = 0  # roles replaced by the failure monitor
         if commit_pipeline != "sync":
             from foundationdb_tpu.server.batcher import BatchingCommitProxy
 
@@ -150,6 +151,80 @@ class Cluster:
                 self.commit_proxy, max_batch=commit_batch_max,
                 flush_after=commit_flush_after, mode=commit_pipeline,
             )
+
+    # ── failure detection + recruitment ──────────────────────────────
+    # Ref: fdbserver/ClusterController.actor.cpp failureDetectionServer +
+    # workerAvailabilityWatch: the controller notices dead role instances
+    # and recruits replacements. In-process there is no network heartbeat
+    # to miss; "detection" is observing a killed instance's alive flag on
+    # the monitor's next round — the same detect-latency shape, minus
+    # packet plumbing. The simulation (or an operator loop) pumps
+    # ``detect_and_recruit()``.
+    def detect_and_recruit(self):
+        """One failure-monitor round; returns [(role, index), ...] of
+        recruitments performed."""
+        events = []
+        if isinstance(self.tlog, TLogSystem):
+            for i, log in enumerate(self.tlog.logs):
+                if not log.alive:
+                    self.tlog.revive(i)
+                    events.append(("tlog", i))
+        for i, r in enumerate(self.resolvers):
+            if not r.alive:
+                # fresh resolver with an empty conflict history MUST fence
+                # every pre-death read version (it cannot check them), so
+                # its window opens at the current committed version —
+                # in-flight txns retry with fresh reads (ref: resolver
+                # failure forcing a recovery that fences the old epoch)
+                self.resolvers[i] = Resolver(
+                    self.knobs,
+                    base_version=self.sequencer.committed_version,
+                )
+                events.append(("resolver", i))
+        for sid, s in enumerate(self.storages):
+            if not s.alive:
+                self._recruit_storage(sid)
+                events.append(("storage", sid))
+        if events:
+            self.recruitments += len(events)
+            TraceEvent("RolesRecruited").detail(events=events).log()
+        return events
+
+    def _recruit_storage(self, sid):
+        """Replace a dead storage by rebooting onto its durable engine
+        and replaying the log from there (ref: a storage process
+        rejoining — open the disk store, peek the tlog from the durable
+        version). The in-memory MVCC overlay died with the process; the
+        tlog covers the gap because the durability pump never pops past a
+        dead storage's durable version. The engine object (file handle,
+        versioned-ness) carries over, so replacement semantics match its
+        peers."""
+        old = self.storages[sid]
+        new = StorageServer(
+            window_versions=self.knobs.max_read_transaction_life_versions,
+            engine=old.engine,
+        )
+        smap = self.dd.map if self.replication < len(self.storages) else None
+        from foundationdb_tpu.core.mutations import Op
+
+        def owned(m):
+            if smap is None:
+                return True
+            if m.op == Op.CLEAR_RANGE:
+                return any(
+                    sid in smap.teams[i]
+                    for i in smap.shards_overlapping(m.key, m.param)
+                )
+            return sid in smap.team_for(m.key)
+
+        for version, muts in self.tlog.peek(new.version):
+            new.apply(version, [m for m in muts if owned(m)])
+        self.storages[sid] = new  # lists are shared: router/proxy/dd see it
+        # watches parked on the dead instance wake so clients re-read and
+        # re-register against the replacement
+        for key in list(old._watches):
+            for w in old._watches.pop(key):
+                w._fire()
 
     # v1: single storage team holding the whole keyspace; reads go to [0].
     @property

@@ -11,6 +11,7 @@ resolver cost grows with batch size).
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
+from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.tlog import TLogDown
 
@@ -72,7 +73,13 @@ class CommitProxy:
             )
             for r in requests
         ]
-        statuses = self._resolve(txns, cv, window)
+        try:
+            statuses = self._resolve(txns, cv, window)
+        except ResolverDown:
+            # resolution never ran: definitively not committed (1020,
+            # retryable without 1021 disambiguation); the failure monitor
+            # recruits a fenced replacement resolver
+            return [FDBError.from_name("not_committed") for _ in requests]
 
         results = []
         batch_mutations = []
@@ -123,6 +130,11 @@ class CommitProxy:
                 for r in results
             ]
         for sid, muts in enumerate(self._route(batch_mutations)):
+            if not self.storages[sid].alive:
+                # a detected-dead storage misses the batch; recruitment
+                # replaces it wholesale (re-ingest from live teammates),
+                # so skipping cannot strand a partial state
+                continue
             self.storages[sid].apply(cv, muts)
             self.storages[sid].advance_window(window)
         self.sequencer.report_committed(cv)
@@ -142,15 +154,20 @@ class CommitProxy:
         The lag is measured BEFORE flushing: it is the backlog this pump
         found, which is what admission control must react to (after a
         synchronous flush it would always read zero)."""
-        lag = max(
-            0, window - min(s.durable_version for s in self.storages)
-        )
-        for s in self.storages:
+        live = [s for s in self.storages if s.alive]
+        if not live:
+            return
+        lag = max(0, window - min(s.durable_version for s in live))
+        for s in live:
             # a versioned (Redwood-role) engine keeps sub-durable reads
             # serveable, so durability can run all the way to the latest
             # version; single-version engines stop at the window floor or
             # reads below the fold would silently lose history
             s.flush(None if s.versioned_engine else window)
+        # pop floor includes DEAD storages' frozen durable versions: their
+        # recruitment replays the tlog from there, so those records must
+        # survive until the replacement catches up (the log grows for at
+        # most the detection window)
         self.tlog.pop(min(s.durable_version for s in self.storages))
         if self.ratekeeper is not None:
             self.ratekeeper.update(storage_lag_versions=lag)

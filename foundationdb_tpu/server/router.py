@@ -25,8 +25,13 @@ class StorageRouter(RangeReadInterface):
         self._rr = rr_counter  # shared round-robin counter (cluster-owned)
 
     def _pick(self, team):
-        """One replica of a team (ref: LoadBalance — spread reads)."""
-        return self.storages[team[next(self._rr) % len(team)]]
+        """One LIVE replica of a team (ref: LoadBalance — spread reads,
+        route around detected-dead interfaces). With every replica dead
+        the read fails retryable; recruitment brings one back."""
+        live = [sid for sid in team if self.storages[sid].alive]
+        if not live:
+            raise err("process_behind")
+        return self.storages[live[next(self._rr) % len(live)]]
 
     def storage_for(self, key):
         return self._pick(self.map.team_for(key))
@@ -38,9 +43,12 @@ class StorageRouter(RangeReadInterface):
         moment a joiner ingests a shard (its floor rises to the source's)
         — a read between two floors must fail TOO_OLD on the raised-floor
         shard, never silently omit its keys."""
-        if version < min(s.oldest_version for s in self.storages):
+        live = [s for s in self.storages if s.alive]
+        if not live:
+            raise err("process_behind")
+        if version < min(s.oldest_version for s in live):
             raise err("transaction_too_old")
-        if version > max(s.version for s in self.storages):
+        if version > max(s.version for s in live):
             raise err("future_version")
 
     @property

@@ -131,6 +131,7 @@ class StorageServer(RangeReadInterface):
         # readers hold the same lock (RLock: flush iterates internally).
         # Single-threaded deployments pay one uncontended acquire per op.
         self._mu = threading.RLock()
+        self.alive = True  # failure detection flips this (sim kill)
         self.engine = engine if engine is not None else KeyValueStoreMemory()
         # Versioned engines (the Redwood role, kvstore.KeyValueStoreVersioned)
         # store per-key version chains, so the MVCC window extends into the
@@ -247,8 +248,19 @@ class StorageServer(RangeReadInterface):
             self.oldest_version = max(self.oldest_version, up_to_version)
         return self.durable_version
 
+    def kill(self):
+        """Process death: volatile state is gone for callers (reads and
+        watches error until the cluster controller recruits a
+        replacement). Ref: sim2 killing one storage process."""
+        self.alive = False
+
     # ───────────────────────────── reads ───────────────────────────────
     def _check_version(self, version):
+        if not self.alive:
+            # retryable: the client re-routes / waits out recruitment
+            # (ref: the client's wrong_shard_server / future_version retry
+            # loop against a dead storage interface)
+            raise err("process_behind")
         if version < self.oldest_version:
             raise err("transaction_too_old")
         if version > self.version:
@@ -399,6 +411,8 @@ class StorageServer(RangeReadInterface):
                         w._fire()
 
     def watch(self, key, seen_value):
+        if not self.alive:
+            raise err("process_behind")
         with self._mu:
             w = Watch(key, seen_value)
             current = self._lookup(key, self.version)

@@ -31,19 +31,26 @@ class TLog:
         self._wal = open(wal_path, "ab") if wal_path else None
         self._pop_holds = {}  # name -> version: keep records > version
 
+    def _wal_append(self, record):
+        """Length+CRC-framed durable append (one framing for push and
+        rollback markers — recovery depends on them agreeing)."""
+        if self._wal is None:
+            return
+        payload = pickle.dumps(record, protocol=4)
+        self._wal.write(
+            struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
+        )
+        self._wal.flush()
+        if self.fsync:
+            os.fsync(self._wal.fileno())
+
     def push(self, version, mutations):
         if not self.alive:
             raise TLogDown()
         if self._log and version <= self._log[-1][0]:
             raise ValueError("tlog push out of order")
         self._log.append((version, mutations))
-        if self._wal is not None:
-            payload = pickle.dumps((version, mutations), protocol=4)
-            rec = struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
-            self._wal.write(rec)
-            self._wal.flush()
-            if self.fsync:
-                os.fsync(self._wal.fileno())
+        self._wal_append((version, mutations))
 
     def rollback(self, version):
         """Undo a just-pushed tail record that failed to reach its
@@ -56,14 +63,7 @@ class TLog:
             raise TLogDown()
         if self._log and self._log[-1][0] == version:
             self._log.pop()
-            if self._wal is not None:
-                payload = pickle.dumps(("abort", version), protocol=4)
-                self._wal.write(
-                    struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
-                )
-                self._wal.flush()
-                if self.fsync:
-                    os.fsync(self._wal.fileno())
+            self._wal_append(("abort", version))
 
     def peek(self, from_version):
         """All records with version > from_version, in order."""
@@ -102,7 +102,6 @@ class TLog:
         """Replay a WAL file → list[(version, mutations)], tolerating a
         torn tail (ref: DiskQueue recovery)."""
         out = []
-        aborted = set()
         try:
             with open(wal_path, "rb") as f:
                 data = f.read()
@@ -117,13 +116,17 @@ class TLog:
             if zlib.crc32(payload) != crc:
                 break
             rec = pickle.loads(payload)
-            if rec[0] == "abort":  # quorum-failure rollback marker
-                aborted.add(rec[1])
+            if rec[0] == "abort":
+                # rollback marker undoes the PRECEDING record with that
+                # version only (positional: a later re-grant of the same
+                # version number is a distinct, valid record)
+                for i in range(len(out) - 1, -1, -1):
+                    if out[i][0] == rec[1]:
+                        del out[i]
+                        break
             else:
                 out.append(rec)
             off += 8 + ln
-        if aborted:
-            out = [r for r in out if r[0] not in aborted]
         return out
 
 
@@ -157,17 +160,22 @@ class TLogSystem:
         self.logs[i].alive = False
 
     def revive(self, i):
-        """A rebooted replica rejoins empty-caught-up: it copies a live
-        peer's suffix (ref: a new tlog generation starting from the
-        recovery version, not the reference's exact mechanism)."""
+        """A rebooted replica rejoins caught-up from a live peer (ref: a
+        new tlog generation starting from the recovery version). Without
+        a live donor it STAYS dead and returns None — rejoining with a
+        gap would make merged peeks silently lose acked records that
+        other (now-dead) replicas hold."""
         log = self.logs[i]
+        donor = next(
+            (l for l in self.logs if l.alive and l is not log), None
+        )
+        if donor is None:
+            return None
         log.alive = True
         log._log = []
-        donor = next((l for l in self.logs if l.alive and l is not log), None)
-        if donor is not None:
-            log._first_version = donor._first_version
-            for v, m in donor.peek(0):
-                log.push(v, m)
+        log._first_version = donor._first_version
+        for v, m in donor.peek(0):
+            log.push(v, m)
         return log
 
     @property

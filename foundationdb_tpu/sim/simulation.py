@@ -134,9 +134,13 @@ class Simulation:
         from foundationdb_tpu.utils.trace import global_trace_log
 
         global_trace_log().clock = lambda: self.steps
+        n_storage = self.cluster_kwargs.get("n_storage", 1)
         self.cluster = Cluster(
             wal_path=self._wal_path,
-            storage_engines=[open_engine(self.engine_kind, self._store_path)],
+            storage_engines=[
+                open_engine(self.engine_kind, f"{self._store_path}.{i}")
+                for i in range(n_storage)
+            ],
             n_resolvers=self.n_resolvers,
             # coordinators persist beside the WAL so crash_and_recover
             # exercises the real quorum-locking recovery path
@@ -165,7 +169,8 @@ class Simulation:
             self.cluster.commit_proxy.fail_pending(
                 err("commit_unknown_result")
             )
-        self.cluster.storage.engine.close()
+        for s in self.cluster.storages:
+            s.engine.close()
         self.cluster.tlog.close()
         old_db = self.db
         self._build_cluster()
@@ -189,7 +194,7 @@ class Simulation:
                 raise RuntimeError(f"simulation exceeded {max_steps} steps")
             if self.crash_p and self.buggify("cluster_crash", fire_p=self.crash_p):
                 self.crash_and_recover()
-            self._maybe_fault_tlogs()
+            self._maybe_fault_roles()
             i = self.rng.randrange(len(live))
             self.schedule_hash = (self.schedule_hash * 1000003 + i) & (2**64 - 1)
             name, gen = live[i]
@@ -203,33 +208,78 @@ class Simulation:
                 self._pump(self.steps)
         self._actors = []
 
-    def _maybe_fault_tlogs(self):
-        """Replicated-log fault sites: kill a live tlog replica (never
-        below the ack quorum, so the cluster keeps committing with a
-        degraded log tier) and revive dead ones caught-up-from-a-peer
-        (ref: sim2 killing individual processes, not whole clusters)."""
-        tl = self.cluster.tlog
-        if not isinstance(tl, TLogSystem):
-            return
+    # steps between failure-monitor rounds: kills stay undetected for a
+    # window, so clients really do hit (and retry through) dead roles
+    MONITOR_EVERY = 7
+
+    def _maybe_fault_roles(self):
+        """Role-level fault sites (ref: sim2 killing individual
+        processes, not whole clusters):
+
+        - tlog replica kill — never below the ack quorum, so the cluster
+          keeps committing on a degraded log tier;
+        - storage kill — only when every shard it owns has another live
+          owner, so recruitment can re-replicate (a real deployment's
+          minimum-replication constraint);
+        - resolver kill — any time; recruitment fences the old epoch.
+
+        The failure monitor (cluster.detect_and_recruit) runs every
+        MONITOR_EVERY steps; between death and detection clients see
+        retryable errors and ride them out.
+        """
+        c = self.cluster
+        tl = c.tlog
+        self.role_kills = getattr(self, "role_kills", 0)
         self.tlog_kills = getattr(self, "tlog_kills", 0)
-        if tl.live_count > tl.quorum and self.buggify("tlog_kill", fire_p=0.004):
-            live = [i for i, l in enumerate(tl.logs) if l.alive]
-            tl.kill(self.rng.choice(live))
-            self.tlog_kills += 1
-        dead = [i for i, l in enumerate(tl.logs) if not l.alive]
-        if dead and self.buggify("tlog_revive", fire_p=0.01):
-            tl.revive(self.rng.choice(dead))
+        if isinstance(tl, TLogSystem):
+            if tl.live_count > tl.quorum and self.buggify("tlog_kill", fire_p=0.004):
+                live = [i for i, l in enumerate(tl.logs) if l.alive]
+                tl.kill(self.rng.choice(live))
+                self.tlog_kills += 1
+            dead = [i for i, l in enumerate(tl.logs) if not l.alive]
+            if dead and self.buggify("tlog_revive", fire_p=0.01):
+                tl.revive(self.rng.choice(dead))
+        if len(c.storages) > 1 and self.buggify("storage_kill", fire_p=0.003):
+            victims = [
+                sid for sid, s in enumerate(c.storages)
+                if s.alive and self._storage_killable(sid)
+            ]
+            if victims:
+                c.storages[self.rng.choice(victims)].kill()
+                self.role_kills += 1
+        if self.buggify("resolver_kill", fire_p=0.002):
+            live = [i for i, r in enumerate(c.resolvers) if r.alive]
+            if live:
+                c.resolvers[self.rng.choice(live)].kill()
+                self.role_kills += 1
+        if self.steps % self.MONITOR_EVERY == 0:
+            c.detect_and_recruit()
+
+    def _storage_killable(self, sid):
+        """Every shard sid owns must keep one other live owner."""
+        c = self.cluster
+        for team in c.dd.map.teams:
+            if sid in team and not any(
+                t != sid and c.storages[t].alive for t in team
+            ):
+                return False
+        return True
 
     def quiesce(self):
-        """Flush storage so everything is durable (end-of-run barrier)."""
+        """Flush storage so everything is durable (end-of-run barrier);
+        recruit any still-dead roles first so the final checks read a
+        healed cluster."""
+        self.cluster.detect_and_recruit()
         if hasattr(self.cluster.commit_proxy, "flush"):
             self.cluster.commit_proxy.flush()
-        self.cluster.storage.flush()
+        for s in self.cluster.storages:
+            s.flush()
 
     def close(self):
         """Close WAL/engine handles (the datadir itself is left for
         inspection; callers own its lifetime)."""
-        self.cluster.storage.engine.close()
+        for s in self.cluster.storages:
+            s.engine.close()
         self.cluster.tlog.close()
 
     def __enter__(self):
