@@ -1,0 +1,50 @@
+"""System keyspace layout: cluster metadata stored as ordinary keys.
+
+Ref parity: fdbclient/SystemData.cpp — the reference persists its shard
+map in the ``\\xff/keyServers/`` range (one row per shard boundary whose
+value names the owning team) and configuration under ``\\xff/conf/``.
+Storing the map IN the database is what lets recovery rebuild placement
+instead of resetting to full replication: the rows ride the same tlog →
+storage pipeline as user data.
+"""
+
+import json
+
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+KEY_SERVERS_END = b"\xff/keyServers0"  # '0' = '/'+1
+CONF_REPLICATION = b"\xff/conf/replication"
+
+
+def encode_shard_map(shard_map):
+    """ShardMap → [(key, value)] rows: one row per shard, keyed by its
+    begin boundary, value = the owning team (ids are stable across
+    recovery because storages are recruited in engine order)."""
+    rows = []
+    for i, begin in enumerate(shard_map.boundaries):
+        rows.append(
+            (
+                KEY_SERVERS_PREFIX + begin,
+                json.dumps(
+                    {"team": shard_map.teams[i], "size": shard_map.sizes[i]}
+                ).encode(),
+            )
+        )
+    return rows
+
+
+def decode_shard_map(rows):
+    """[(key, value)] rows → (boundaries, teams, sizes), or None when no
+    rows were persisted (bootstrap)."""
+    if not rows:
+        return None
+    boundaries, teams, sizes = [], [], []
+    for k, v in rows:
+        if not k.startswith(KEY_SERVERS_PREFIX):
+            continue
+        meta = json.loads(v.decode())
+        boundaries.append(k[len(KEY_SERVERS_PREFIX):])
+        teams.append([int(s) for s in meta["team"]])
+        sizes.append(int(meta.get("size", 0)))
+    if not boundaries or boundaries[0] != b"":
+        return None  # torn/partial map: fall back to bootstrap placement
+    return boundaries, teams, sizes

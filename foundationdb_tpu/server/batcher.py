@@ -200,6 +200,8 @@ class BatchingCommitProxy:
                 # would interleave two commit_batch runs on shared state
                 return
         self.flush()
+        if hasattr(self.inner, "close"):
+            self.inner.close()  # release the sub-resolve pool
 
     # pass everything else (commit_count, pump_durability, …) through
     def __getattr__(self, name):

@@ -124,12 +124,35 @@ class Cluster:
         # full replica); replication < n_storage partitions the keyspace
         # into shards owned by teams of that size, with the commit proxy
         # routing writes and the StorageRouter stitching reads. The shard
-        # map itself is rebuilt at recovery (the WAL replays everywhere,
-        # so recovered storages open as full replicas until DD
-        # re-partitions); persisting the map in the system keyspace the
-        # way the reference's keyServers does is future work.
+        # map persists in the \xff/keyServers/ system keyspace (ref:
+        # fdbclient/SystemData.cpp) — recovery restores the partitioning
+        # instead of resetting to full replication. (The WAL still
+        # replays everywhere, so non-owners briefly hold shadow copies of
+        # recovered data; routing never reads them and relocations clear
+        # before installing.)
+        from foundationdb_tpu.core import systemdata
+        from foundationdb_tpu.server.datadistribution import ShardMap
+
+        restored_map = None
+        if recovered_records:
+            s0 = self.storages[0]
+            rows = s0.read_range(
+                systemdata.KEY_SERVERS_PREFIX, systemdata.KEY_SERVERS_END,
+                s0.version,
+            )
+            decoded = systemdata.decode_shard_map(rows)
+            if decoded is not None:
+                restored_map = ShardMap.restore(*decoded)
+                rep_row = s0.get(systemdata.CONF_REPLICATION, s0.version)
+                if rep_row is not None:
+                    replication = int(rep_row)
+                TraceEvent("ShardMapRestored").detail(
+                    shards=len(restored_map), replication=replication).log()
         self.replication = replication or n_storage
-        self.dd = DataDistributor(self.storages, replication=self.replication)
+        self.dd = DataDistributor(
+            self.storages, shard_map=restored_map,
+            replication=self.replication,
+        )
         self._read_rr = itertools.count()  # round-robin read balancing
         self.router = StorageRouter(self.storages, self.dd.map, self._read_rr)
         self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
@@ -166,8 +189,7 @@ class Cluster:
         events = []
         if isinstance(self.tlog, TLogSystem):
             for i, log in enumerate(self.tlog.logs):
-                if not log.alive:
-                    self.tlog.revive(i)
+                if not log.alive and self.tlog.revive(i) is not None:
                     events.append(("tlog", i))
         for i, r in enumerate(self.resolvers):
             if not r.alive:
@@ -210,6 +232,8 @@ class Cluster:
         def owned(m):
             if smap is None:
                 return True
+            if m.key >= b"\xff":
+                return True  # system keyspace replicates everywhere
             if m.op == Op.CLEAR_RANGE:
                 return any(
                     sid in smap.teams[i]
@@ -238,8 +262,41 @@ class Cluster:
         return self.router
 
     def rebalance(self):
-        """One data-distribution round (splits/merges/moves)."""
-        return self.dd.rebalance()
+        """One data-distribution round (splits/merges/moves), then
+        persist the new map in the system keyspace and re-derive the
+        resolver key ranges from it."""
+        moves = self.dd.rebalance()
+        self.persist_shard_map()
+        self.commit_proxy.update_resolver_ranges()
+        return moves
+
+    def persist_shard_map(self):
+        """Write the live shard map to \\xff/keyServers/ through the
+        normal commit pipeline — tlog-durable, recovered like user data
+        (ref: keyServers commits in SystemData.cpp). Best-effort: a
+        failed system commit (fault injection, log quorum loss) leaves
+        the previous persisted map; the next round retries."""
+        from foundationdb_tpu.core import systemdata
+        from foundationdb_tpu.core.mutations import Mutation, Op
+        from foundationdb_tpu.server.proxy import CommitRequest
+
+        muts = [Mutation(Op.CLEAR_RANGE, systemdata.KEY_SERVERS_PREFIX,
+                         systemdata.KEY_SERVERS_END)]
+        muts += [
+            Mutation(Op.SET, k, v)
+            for k, v in systemdata.encode_shard_map(self.dd.map)
+        ]
+        muts.append(Mutation(
+            Op.SET, systemdata.CONF_REPLICATION,
+            str(self.replication).encode(),
+        ))
+        req = CommitRequest(
+            read_version=self.sequencer.committed_version,
+            mutations=muts, read_conflict_ranges=[],
+            write_conflict_ranges=[],
+        )
+        result = self.commit_proxy.commit(req)
+        return not isinstance(result, Exception)
 
     def database(self):
         from foundationdb_tpu.txn.database import Database

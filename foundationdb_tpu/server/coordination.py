@@ -132,6 +132,8 @@ class CoordinationQuorum:
     @classmethod
     def local(cls, n=3, dir_path=None):
         """An in-process quorum of n coordinators (simulation deployment)."""
+        if dir_path:
+            os.makedirs(dir_path, exist_ok=True)
         coords = [
             Coordinator(
                 os.path.join(dir_path, f"coordinator-{i}.json")

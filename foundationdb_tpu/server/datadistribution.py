@@ -37,6 +37,17 @@ class ShardMap:
         self.sizes = [0]  # sampled bytes per shard
         self.last_keys = [None]  # most recent write per shard
 
+    @classmethod
+    def restore(cls, boundaries, teams, sizes=None):
+        """Rebuild from persisted system-keyspace rows (ref: reading
+        keyServers at recovery)."""
+        m = cls()
+        m.boundaries = list(boundaries)
+        m.teams = [list(t) for t in teams]
+        m.sizes = list(sizes) if sizes else [0] * len(boundaries)
+        m.last_keys = [None] * len(boundaries)
+        return m
+
     def team_for(self, key):
         return self.teams[bisect.bisect_right(self.boundaries, key) - 1]
 
@@ -144,10 +155,13 @@ class DataDistributor:
             i += 1
 
     def _split_point(self, i):
-        """Median key of the shard from the owning storage's live data."""
+        """Median key of the shard from a LIVE owning storage's data."""
         b, e = self.map.shard_range(i)
         team = self.map.teams[i]
-        storage = self.storages[team[0]]
+        live = [s for s in team if self.storages[s].alive]
+        if not live:
+            return None  # split waits until recruitment revives an owner
+        storage = self.storages[live[0]]
         keys = [k for k, _ in storage.read_range(
             b, e, storage.version, limit=1001)]
         if len(keys) < 2:
@@ -195,17 +209,25 @@ class DataDistributor:
             i = max(cands, key=self.map.sizes.__getitem__)
             old_team = list(self.map.teams[i])
             new_team = [cold if s == hot else s for s in old_team]
-            self._relocate(i, old_team, new_team)
+            if not self._relocate(i, old_team, new_team):
+                break  # dead participant: retry after recruitment
             moves.append((self.map.shard_range(i), old_team, new_team))
         return moves
 
     def _relocate(self, i, old_team, new_team):
         """Copy shard data to joining storages, then flip the map entry
-        (ref: fetchKeys then the keyServers commit)."""
+        (ref: fetchKeys then the keyServers commit). Refuses (returns
+        False, map untouched) when no live source exists or a joiner is
+        dead — exporting a corpse's frozen overlay would install stale
+        data under the new map, and a dead joiner's ingest dies with it
+        at recruitment."""
         b, e = self.map.shard_range(i)
-        src = self.storages[old_team[0]]
+        live_src = [s for s in old_team if self.storages[s].alive]
         joining = [s for s in new_team if s not in old_team]
         leaving = [s for s in old_team if s not in new_team]
+        if not live_src or any(not self.storages[s].alive for s in joining):
+            return False
+        src = self.storages[live_src[0]]
         if joining:
             export = src.export_shard(b, e)  # one snapshot, k joiners
             for sid in joining:
@@ -217,3 +239,4 @@ class DataDistributor:
             self.storages[sid].fire_watches_in_range(b, e)
         TraceEvent("DDRelocateShard").detail(
             begin=b, end=e, old=old_team, new=new_team).log()
+        return True

@@ -45,6 +45,48 @@ class CommitProxy:
         self.conflict_count = 0
         self._batches_since_pump = 0
         self.pump_interval = 64  # batches between flush + ratekeeper rounds
+        self.resolver_bounds = None  # n-1 split keys; None = static split
+        self._pool = None  # lazy thread pool for concurrent sub-resolves
+        self.update_resolver_ranges(fence=False)
+
+    def update_resolver_ranges(self, fence=True):
+        """Derive each resolver's key range from the LIVE DD shard map,
+        weighting by sampled shard bytes so resolver load tracks actual
+        write traffic (ref: the keyResolvers map the proxies maintain
+        from keyServers). Falls back to a static first-byte split until
+        the map has enough shards to cut n balanced ranges. The cluster
+        calls this after every DD rebalance round and at recovery.
+
+        Moving a boundary makes conflict history recorded under the OLD
+        split unreachable (a key's writes live in the resolver that used
+        to own it), so a bounds change REBUILDS the resolvers fenced at
+        the current committed version — in-flight transactions get
+        TOO_OLD and retry with fresh reads, exactly like the reference,
+        where resolver ranges only change through a fencing recovery.
+        ``fence=False`` is for construction, when no history exists yet.
+        """
+        n = len(self.resolvers)
+        if n == 1:
+            return
+        smap = self.dd.map if self.dd is not None else None
+        if smap is None or len(smap) < n:
+            new_bounds = None  # static split
+        else:
+            weights = [s + 1 for s in smap.sizes]  # +1: empty shards count
+            total = sum(weights)
+            bounds, acc = [], 0
+            for i in range(len(smap) - 1):
+                acc += weights[i]
+                if acc >= (len(bounds) + 1) * total / n and len(bounds) < n - 1:
+                    bounds.append(smap.boundaries[i + 1])
+            new_bounds = bounds if len(bounds) == n - 1 else None
+        if new_bounds != self.resolver_bounds and fence:
+            from foundationdb_tpu.resolver.resolver import Resolver
+
+            cv = self.sequencer.committed_version
+            for i in range(n):
+                self.resolvers[i] = Resolver(self.knobs, base_version=cv)
+        self.resolver_bounds = new_bounds
 
     def commit(self, request):
         """Single-transaction batch (the synchronous client path)."""
@@ -105,6 +147,8 @@ class CommitProxy:
 
         if self.dd is not None:
             for m in batch_mutations:
+                if m.key >= b"\xff":
+                    continue  # system rows are not user load samples
                 if m.op == Op.CLEAR_RANGE:
                     self.dd.note_clear_range(m.key, m.param)
                 else:
@@ -185,7 +229,12 @@ class CommitProxy:
         smap = self.dd.map
         per = [[] for _ in range(n)]
         for m in mutations:
-            if m.op == Op.CLEAR_RANGE:
+            if m.key >= b"\xff":
+                # system keyspace replicates everywhere: recovery must be
+                # able to read the shard map from any surviving storage
+                # (ref: the system keyspace's wider replication)
+                owners = range(n)
+            elif m.op == Op.CLEAR_RANGE:
                 owners = set()
                 for i in smap.shards_overlapping(m.key, m.param):
                     owners.update(smap.teams[i])
@@ -203,22 +252,35 @@ class CommitProxy:
         # shard; a txn commits iff EVERY resolver accepts it. Because a txn's
         # fate must be agreed, each resolver is also told the full batch
         # structure (masked to its shard) and the proxy ANDs the verdicts.
+        # Sub-batches dispatch concurrently: each resolver's work (packing
+        # + kernel dispatch, or the GIL-releasing native conflict set) is
+        # independent; verdicts join in resolver order, so the result is
+        # schedule-independent (deterministic under the sim).
         n = len(self.resolvers)
-        verdicts = []
-        for ri, res in enumerate(self.resolvers):
-            lo, hi = self._shard_bounds(ri, n)
-            shard_txns = []
-            for t in txns:
-                shard_txns.append(
-                    TxnRequest(
-                        read_version=t.read_version,
-                        point_reads=_clip_points(t.point_reads, lo, hi),
-                        point_writes=_clip_points(t.point_writes, lo, hi),
-                        range_reads=_clip(t.range_reads, lo, hi),
-                        range_writes=_clip(t.range_writes, lo, hi),
-                    )
+        shard_batches = []
+        for ri in range(n):
+            lo, hi = self._resolver_range(ri, n)
+            shard_batches.append([
+                TxnRequest(
+                    read_version=t.read_version,
+                    point_reads=_clip_points(t.point_reads, lo, hi),
+                    point_writes=_clip_points(t.point_writes, lo, hi),
+                    range_reads=_clip(t.range_reads, lo, hi),
+                    range_writes=_clip(t.range_writes, lo, hi),
                 )
-            verdicts.append(res.resolve(shard_txns, cv, window))
+                for t in txns
+            ])
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="sub-resolve"
+            )
+        futs = [
+            self._pool.submit(res.resolve, batch, cv, window)
+            for res, batch in zip(self.resolvers, shard_batches)
+        ]
+        verdicts = [f.result() for f in futs]
         out = []
         for i in range(len(txns)):
             vs = [v[i] for v in verdicts]
@@ -230,11 +292,23 @@ class CommitProxy:
                 out.append(CONFLICT)
         return out
 
-    def _shard_bounds(self, i, n):
-        """Evenly split the keyspace by first byte (v1 static shards;
-        DataDistribution will own real shard maps). The last shard's upper
-        bound is None = +infinity so no key — including the \\xff system
+    def close(self):
+        """Release the sub-resolve thread pool (simulation rebuilds the
+        cluster on every injected crash — stranded pools add up)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _resolver_range(self, i, n):
+        """Resolver i's key range: DD-derived bounds when available,
+        else an even first-byte split. The last range's upper bound is
+        None = +infinity so no key — including the \\xff system
         keyspace — escapes conflict checking."""
+        b = self.resolver_bounds
+        if b is not None:
+            lo = b[i - 1] if i else b""
+            hi = b[i] if i < len(b) else None
+            return lo, hi
         lo = bytes([256 * i // n]) if i else b""
         hi = bytes([256 * (i + 1) // n]) if i + 1 < n else None
         return lo, hi

@@ -169,6 +169,7 @@ class Simulation:
             self.cluster.commit_proxy.fail_pending(
                 err("commit_unknown_result")
             )
+        self.cluster.commit_proxy.close()
         for s in self.cluster.storages:
             s.engine.close()
         self.cluster.tlog.close()
@@ -278,6 +279,7 @@ class Simulation:
     def close(self):
         """Close WAL/engine handles (the datadir itself is left for
         inspection; callers own its lifetime)."""
+        self.cluster.commit_proxy.close()
         for s in self.cluster.storages:
             s.engine.close()
         self.cluster.tlog.close()
