@@ -22,6 +22,7 @@ from foundationdb_tpu.server.cluster import Cluster
 from foundationdb_tpu.server.kvstore import open_engine
 from foundationdb_tpu.server.tlog import TLogSystem
 from foundationdb_tpu.sim.buggify import Buggify
+from foundationdb_tpu.sim.network import SimNetwork
 
 
 class FaultyCommitProxy:
@@ -116,6 +117,13 @@ class Simulation:
         self.steps = 0
         self.schedule_hash = 0  # order-sensitive digest of scheduling choices
         self._actors = []  # (name, generator)
+        # message-level network (ref: sim2): workloads built on
+        # net_exec/net_*_workload route every op through it; it survives
+        # cluster crashes (infrastructure outlives incarnations) and
+        # in-flight messages resolve against the new one via the Database
+        self.net = SimNetwork(
+            self.rng, self.buggify, clock=lambda: self.steps
+        )
         self._build_cluster()
         self.db = self.cluster.database()
 
@@ -196,6 +204,9 @@ class Simulation:
             if self.crash_p and self.buggify("cluster_crash", fire_p=self.crash_p):
                 self.crash_and_recover()
             self._maybe_fault_roles()
+            if self.net.pending and self.buggify("net_partition", fire_p=0.0015):
+                self.net.partition(self.rng.randint(5, 30))
+            self.net.deliver_due(self.steps)
             i = self.rng.randrange(len(live))
             self.schedule_hash = (self.schedule_hash * 1000003 + i) & (2**64 - 1)
             name, gen = live[i]

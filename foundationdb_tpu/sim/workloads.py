@@ -413,3 +413,126 @@ def atomic_counter_check(db, totals, prefix=b"ctr/"):
         raw = db.get(prefix + b"c%d" % c)
         got = struct.unpack("<q", raw)[0] if raw else 0
         assert got == expect, f"counter {c}: {got} != {expect}"
+
+
+# ─────────────────── message-level network workloads ────────────────────
+def net_exec(net, gen):
+    """Drive a thunk-generator over the simulated network: each item the
+    generator yields is sent as a message (``(kind, thunk)`` or a bare
+    thunk), the actor yields to the scheduler until the reply delivers,
+    and the generator resumes with the result. Errors (conflicts, drops,
+    fencing) propagate to the caller's retry logic."""
+    try:
+        item = next(gen)
+        while True:
+            kind, thunk = (
+                item if isinstance(item, tuple) else ("call", item)
+            )
+            fut = net.call(thunk, kind=kind)
+            while not fut.done:
+                yield
+            item = gen.send(fut.result())
+    except StopIteration as s:
+        return s.value
+
+
+def _net_cycle_txn(tr, key, r):
+    a = _dec((yield (lambda: tr.get(key(r)))))
+    b = _dec((yield (lambda: tr.get(key(a)))))
+    c = _dec((yield (lambda: tr.get(key(b)))))
+
+    def relink():
+        tr.set(key(r), _enc(b))
+        tr.set(key(a), _enc(c))
+        tr.set(key(b), _enc(a))
+
+    yield relink
+    yield ("commit", tr.commit)
+
+
+def net_cycle_workload(db, net, n_nodes, n_ops, rng, prefix=b"cycle/"):
+    """Cycle transactions where EVERY operation crosses the simulated
+    network: reads and commits from concurrent actors reorder against
+    each other, stall behind partitions, and drop — the invariant must
+    hold anyway (ref: Cycle.actor.cpp under sim2's network)."""
+    key = lambda i: prefix + _enc(i)
+    ops = 0
+    while ops < n_ops:
+        tr = db.create_transaction()
+        r = rng.randrange(n_nodes)
+        try:
+            yield from net_exec(net, _net_cycle_txn(tr, key, r))
+            ops += 1
+        except FDBError as e:
+            if e.code == 1021:
+                ops += 1  # either way the cycle invariant holds
+            elif not e.is_retryable:
+                raise
+
+
+def _one_op(thunk):
+    """Single-message transaction body for net_exec."""
+    return (yield thunk)
+
+
+def _net_ser_txn(tr, key, receipt_key, ks, token, wval):
+    reads = {}
+    for k in ks:
+        reads[key(k)] = yield (lambda k=k: tr.get(key(k)))
+
+    def write():
+        tr.set(key(ks[0]), wval)
+        tr.set_versionstamped_value(
+            receipt_key, token + b"\x00" * 10 + struct.pack("<I", len(token))
+        )
+
+    yield write
+    yield ("commit", tr.commit)
+    return reads
+
+
+def net_serializability_workload(db, net, log, actor_id, n_txns, n_keys,
+                                 rng, prefix=b"ser/"):
+    """serializability_workload with every read/commit as a reorderable
+    network message; 1021 disambiguation via the versionstamped receipt
+    also rides the network."""
+    key = lambda i: prefix + b"k%03d" % i
+    receipt_key = prefix + b"receipt/%d" % actor_id
+    for t in range(n_txns):
+        token = b"%d:%d:" % (actor_id, t)
+        ks = rng.sample(range(n_keys), 3)
+        wval = _enc(zlib.crc32(token))
+        writes = {key(ks[0]): wval}
+        while True:  # retry loop, one attempt per iteration
+            tr = db.create_transaction()
+            try:
+                reads = yield from net_exec(
+                    net, _net_ser_txn(tr, key, receipt_key, ks, token, wval)
+                )
+                stamp = tr.get_versionstamp()()
+                w = dict(writes)
+                w[receipt_key] = token + stamp
+                log.entries.append((stamp, reads, w))
+                break
+            except FDBError as e:
+                if e.code == 1021:
+                    # ambiguous: disambiguate via the receipt (only this
+                    # actor writes it), itself over the network
+                    while True:
+                        try:
+                            chk = db.create_transaction()
+                            val = yield from net_exec(
+                                net, _one_op(lambda: chk.get(receipt_key))
+                            )
+                            break
+                        except FDBError as e2:
+                            if not e2.is_retryable:
+                                raise
+                    if val is not None and val.startswith(token):
+                        stamp = val[len(token):len(token) + 10]
+                        w = dict(writes)
+                        w[receipt_key] = val
+                        log.entries.append((stamp, None, w))
+                    break
+                if not e.is_retryable:
+                    raise
