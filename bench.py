@@ -352,7 +352,7 @@ def run_e2e(cpu):
     for t in threads:
         t.join(timeout=90)
     elapsed = time.perf_counter() - t0
-    cluster.commit_proxy.close()
+    cluster.close()  # batcher + grv threads, pools, engine/WAL handles
     if errors:
         raise errors[0]
     bp = cluster.commit_proxy

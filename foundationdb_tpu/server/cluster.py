@@ -174,6 +174,15 @@ class Cluster:
                 self.commit_proxy, max_batch=commit_batch_max,
                 flush_after=commit_flush_after, mode=commit_pipeline,
             )
+        if commit_pipeline == "thread":
+            # live deployments batch GRVs too (ref: GrvProxyServer's
+            # transaction-start batching); the sim keeps the synchronous
+            # proxy so admission stays deterministic
+            from foundationdb_tpu.server.grv import BatchingGrvProxy
+
+            self.grv_proxy = BatchingGrvProxy(
+                self.grv_proxy, interval_s=knobs.grv_batch_interval_s,
+            )
 
     # ── failure detection + recruitment ──────────────────────────────
     # Ref: fdbserver/ClusterController.actor.cpp failureDetectionServer +
@@ -249,6 +258,17 @@ class Cluster:
         for key in list(old._watches):
             for w in old._watches.pop(key):
                 w._fire()
+
+    def close(self):
+        """Release background machinery (batcher threads, thread pools)
+        and durable handles."""
+        if hasattr(self.grv_proxy, "close"):
+            self.grv_proxy.close()
+        if hasattr(self.commit_proxy, "close"):
+            self.commit_proxy.close()
+        for s in self.storages:
+            s.engine.close()
+        self.tlog.close()
 
     # v1: single storage team holding the whole keyspace; reads go to [0].
     @property

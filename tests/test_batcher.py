@@ -96,7 +96,7 @@ def test_thread_mode_preserves_occ_conflicts():
     for t in ts:
         t.join()
     assert db.get(b"k") == b"2"  # both eventually applied, serially
-    cluster.commit_proxy.close()
+    cluster.close()
 
 
 def test_sim_manual_batching_with_tpu_resolver(tmp_path):
@@ -226,7 +226,7 @@ def test_batcher_survives_poisoned_batch():
     db.set(b"k", b"2")  # the batcher thread must still be draining
     assert db.get(b"k") == b"2"
     assert isinstance(c.commit_proxy.last_batch_error, IOError)
-    c.commit_proxy.close()
+    c.close()
 
 
 def test_thread_mode_concurrent_range_reads_consistent():
@@ -268,7 +268,7 @@ def test_thread_mode_concurrent_range_reads_consistent():
         for t in threads:
             t.join()
     assert not errors, errors[:3]
-    c.commit_proxy.close()
+    c.close()
 
 
 def test_commit_async_inflight_guards_reuse():
