@@ -150,6 +150,84 @@ class DirectorySubspace(Directory, Subspace):
         return f"DirectorySubspace(path={self._path}, prefix={self.raw_prefix!r})"
 
 
+PARTITION_LAYER = b"partition"
+
+
+class DirectoryPartition(Directory):
+    """A directory whose contents are an ISOLATED directory hierarchy.
+
+    Ref parity: DirectoryPartition in bindings/python/fdb/directory_impl.py
+    — created with ``layer=b"partition"``, it owns a child DirectoryLayer
+    whose node subspace lives inside the partition's prefix
+    (``prefix + \\xfe``), so the whole subtree (metadata AND contents) can
+    be moved or removed as one unit from the parent hierarchy. Paths
+    opened through the partition are RELATIVE to it and allocate from its
+    own HCA; operations on the partition itself (exists/remove/move_to)
+    route to the parent hierarchy. A partition is deliberately NOT a
+    subspace — keys must live in directories created inside it.
+    """
+
+    def __init__(self, path, prefix, parent_layer):
+        prefix = bytes(prefix)
+        child = DirectoryLayer(
+            node_subspace=Subspace(raw_prefix=prefix + b"\xfe"),
+            content_subspace=Subspace(raw_prefix=prefix),
+        )
+        Directory.__init__(self, child, path, PARTITION_LAYER)
+        self._parent_layer = parent_layer
+        self.raw_prefix = prefix  # introspection only; packing is blocked
+
+    def __repr__(self):
+        return f"DirectoryPartition(path={self._path}, prefix={self.raw_prefix!r})"
+
+    def _partition_and_rel(self, path):
+        # contents operations (create/open/list) are relative to the
+        # partition's own hierarchy — its root is the child layer's root
+        return self._directory_layer, _to_path(path)
+
+    def _self_or_rel(self, path):
+        """exists/remove on an empty path target the partition ITSELF —
+        a node of the PARENT hierarchy; deeper paths are child-relative."""
+        p = _to_path(path)
+        if not p:
+            return self._parent_layer, self._path
+        return self._directory_layer, p
+
+    def exists(self, tr, path=()):
+        dl, p = self._self_or_rel(path)
+        return dl.exists(tr, p)
+
+    def remove(self, tr, path=()):
+        dl, p = self._self_or_rel(path)
+        return dl.remove(tr, p)
+
+    def remove_if_exists(self, tr, path=()):
+        dl, p = self._self_or_rel(path)
+        return dl.remove_if_exists(tr, p)
+
+    def move(self, tr, old_path, new_path):
+        # moves are within the partition's own hierarchy, relative paths
+        return self._directory_layer.move(
+            tr, _to_path(old_path), _to_path(new_path)
+        )
+
+    def move_to(self, tr, new_absolute_path):
+        # relocating the partition itself happens in the parent hierarchy
+        return self._parent_layer.move(
+            tr, self._path, _to_path(new_absolute_path)
+        )
+
+    # ── a partition is not a content subspace (ref: the bindings raise) ──
+    def _no_subspace(self, *_a, **_k):
+        raise ValueError(
+            "cannot open a key subspace in the root of a directory "
+            "partition — create a directory inside it"
+        )
+
+    key = pack = unpack = range = contains = subspace = _no_subspace
+    __getitem__ = _no_subspace
+
+
 def _to_path(path):
     if isinstance(path, str):
         return (path,)
@@ -192,8 +270,33 @@ class DirectoryLayer(Directory):
             node = self._node_with_prefix(prefix)
         return node
 
+    def _route(self, tr, path):
+        """Longest-prefix partition routing (ref: the bindings routing
+        every operation through the deepest partition on its path): a
+        path that TRAVERSES a partition delegates the remainder to the
+        partition's own directory layer, whose metadata lives inside the
+        partition prefix and is invisible to this layer's _find. Returns
+        (directory_layer, relative_path); (self, path) when no partition
+        is crossed. The final path element itself being a partition does
+        NOT reroute — operations on the partition node (open/exists/
+        remove/move of the partition) belong to THIS hierarchy."""
+        node = self._root_node
+        for i, name in enumerate(path[:-1]):
+            prefix = tr.get(node[SUBDIRS].pack((name,)))
+            if prefix is None:
+                return self, path  # let the caller raise not-exists
+            node = self._node_with_prefix(prefix)
+            if (tr.get(node.pack((b"layer",))) or b"") == PARTITION_LAYER:
+                part = self._contents_of_node(
+                    node, path[: i + 1], PARTITION_LAYER
+                )
+                return part._directory_layer._route(tr, path[i + 1:])
+        return self, path
+
     def _contents_of_node(self, node, path, layer=b""):
         prefix = self._node_subspace.unpack(node.key())[0]
+        if layer == PARTITION_LAYER:
+            return DirectoryPartition(path, prefix, self)
         return DirectorySubspace(path, prefix, self, layer)
 
     def _check_version(self, tr, write):
@@ -219,6 +322,12 @@ class DirectoryLayer(Directory):
         )
 
     def _create_or_open(self, tr, path, layer, prefix=None, allow_open=True, allow_create=True):
+        dl, rel = self._route(tr, path)
+        if dl is not self:
+            return dl._create_or_open(
+                tr, rel, layer, prefix=prefix,
+                allow_open=allow_open, allow_create=allow_create,
+            )
         self._check_version(tr, write=False)
         if prefix is not None and not self._allow_manual_prefixes:
             raise ValueError("manual prefixes are not enabled on this DirectoryLayer")
@@ -269,19 +378,42 @@ class DirectoryLayer(Directory):
 
     def list(self, tr, path=()):
         self._check_version(tr, write=False)
-        node = self._find(tr, _to_path(path))
+        path = _to_path(path)
+        dl, rel = self._route(tr, path)
+        if dl is not self:
+            return dl.list(tr, rel)
+        node = self._find(tr, path)
         if node is None:
             raise ValueError("the directory does not exist")
+        if path and (tr.get(node.pack((b"layer",))) or b"") == PARTITION_LAYER:
+            # listing a partition's path lists its CONTENTS (child root)
+            return self._contents_of_node(
+                node, path, PARTITION_LAYER
+            )._directory_layer.list(tr, ())
         sub = node[SUBDIRS]
         return [sub.unpack(k)[0] for k, _ in tr.get_range(*sub.range())]
 
     def exists(self, tr, path=()):
         self._check_version(tr, write=False)
-        return self._find(tr, _to_path(path)) is not None
+        path = _to_path(path)
+        dl, rel = self._route(tr, path)
+        if dl is not self:
+            return dl.exists(tr, rel)
+        return self._find(tr, path) is not None
 
     def move(self, tr, old_path, new_path):
         self._check_version(tr, write=True)
         old_path, new_path = _to_path(old_path), _to_path(new_path)
+        old_dl, old_rel = self._route(tr, old_path)
+        new_dl, new_rel = self._route(tr, new_path)
+        # routing builds fresh layer objects, so hierarchies compare by
+        # their node-subspace prefix, not identity
+        if old_dl._node_subspace.raw_prefix != new_dl._node_subspace.raw_prefix:
+            # ref: the bindings refuse moves between partitions (the
+            # content prefix cannot leave the partition's byte range)
+            raise ValueError("cannot move between directory partitions")
+        if old_dl is not self:
+            return old_dl.move(tr, old_rel, new_rel)
         if new_path[: len(old_path)] == old_path:
             raise ValueError("cannot move a directory under itself")
         old_node = self._find(tr, old_path)
@@ -308,6 +440,9 @@ class DirectoryLayer(Directory):
         path = _to_path(path)
         if not path:
             raise ValueError("the root directory cannot be removed")
+        dl, rel = self._route(tr, path)
+        if dl is not self:
+            return dl.remove_if_exists(tr, rel)
         node = self._find(tr, path)
         if node is None:
             return False

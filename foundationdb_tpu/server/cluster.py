@@ -324,7 +324,23 @@ class Cluster:
         return Database(self)
 
     def status(self):
-        """Cluster status summary (ref: fdbcli status json, StatusWorker)."""
+        """Cluster status summary (ref: fdbcli status json, Status.actor.cpp
+        — processes/roles breakdown, qos, data, recovery state)."""
+        rk = self.ratekeeper
+        live_storages = sum(1 for s in self.storages if s.alive)
+        tlog_info = {"count": 1, "live": 1, "quorum": 1, "replicated": False}
+        if isinstance(self.tlog, TLogSystem):
+            tlog_info = {
+                "count": self.tlog.n,
+                "live": self.tlog.live_count,
+                "quorum": self.tlog.quorum,
+                "replicated": True,
+            }
+        degraded = (
+            live_storages < len(self.storages)
+            or tlog_info["live"] < tlog_info["count"]
+            or any(not r.alive for r in self.resolvers)
+        )
         return {
             "cluster": {
                 "generation": self.generation,
@@ -332,8 +348,19 @@ class Cluster:
                 "data": {
                     "shards": len(self.dd.map),
                     "team_bytes": self.dd.team_bytes(),
+                    "replication_factor": self.replication,
+                    "moving_data": False,
                 },
-                "database_available": True,
+                "database_available": live_storages > 0,
+                "degraded": degraded,
+                "recruitments": self.recruitments,
+                "qos": {
+                    "transactions_per_second_limit": rk.target_tps,
+                    "batch_transactions_per_second_limit": (
+                        rk.target_tps * rk.batch_priority_fraction
+                    ),
+                    "throttled_count": rk.throttled_count,
+                },
                 "workload": {
                     "transactions": {
                         "committed": {"counter": self.commit_proxy.commit_count},
@@ -343,6 +370,25 @@ class Cluster:
                 },
                 "latest_version": self.sequencer.committed_version,
                 "oldest_readable_version": self.storage.oldest_version,
+                "commit_pipeline": self.commit_pipeline,
+                "processes": {
+                    "resolvers": [
+                        {"id": i, "alive": r.alive,
+                         "backend": self.knobs.resolver_backend}
+                        for i, r in enumerate(self.resolvers)
+                    ],
+                    "storage_servers": [
+                        {
+                            "id": i,
+                            "alive": s.alive,
+                            "durable_version": s.durable_version,
+                            "oldest_version": s.oldest_version,
+                            "versioned_engine": s.versioned_engine,
+                        }
+                        for i, s in enumerate(self.storages)
+                    ],
+                    "logs": tlog_info,
+                },
                 "resolvers": len(self.resolvers),
                 "resolver_backend": self.knobs.resolver_backend,
                 "storage_servers": len(self.storages),

@@ -47,8 +47,10 @@ class BatchingGrvProxy:
         # line-block default traffic (ref: per-priority GRV queues)
         self._queues = {"default": [], "batch": []}
         self._closed = False
+        self._pending = 0  # queued + drained-but-unresolved requests
         self.batches_granted = 0
         self.delayed_count = 0  # requests that waited ≥1 extra window
+        self.max_round = 0  # largest single-round grant (batch size seen)
         self._thread = threading.Thread(
             target=self._grant_loop, name="grv-batcher", daemon=True
         )
@@ -59,21 +61,22 @@ class BatchingGrvProxy:
 
     def get_read_version(self, priority="default"):
         if priority == "immediate":
-            return self.inner.get_read_version(priority)  # system bypass
+            with self._lock:  # counter consistency with the grant loop
+                return self.inner.get_read_version(priority)  # bypass
         rk = self.inner.ratekeeper
         qkey = "batch" if priority == "batch" else "default"
         with self._lock:
             if (
                 not self._closed
-                and not self._queues["default"]
-                and not self._queues["batch"]
+                and self._pending == 0  # covers drained-but-unresolved too
                 and (rk is None or rk.admit(priority))
             ):
-                # uncontended fast path: nobody queued ahead and the
-                # budget has room — grant inline, no thread handoff.
-                # Batching engages exactly when it pays: bursts (requests
-                # pile up while a round runs) and throttling (admit
-                # fails → queue → delayed grant).
+                # uncontended fast path: no request is ahead of us in ANY
+                # state (queued or mid-round) and the budget has room —
+                # grant inline, no thread handoff. Checking _pending
+                # rather than the raw queues means a fresh arrival can
+                # never steal a refilled token from an older request the
+                # grant loop is currently holding.
                 self.inner.grv_count += 1
                 return self.inner.sequencer.committed_version
         fut = {"event": threading.Event(), "value": None, "error": None,
@@ -83,6 +86,7 @@ class BatchingGrvProxy:
             if self._closed:
                 raise err("process_behind")
             self._queues[qkey].append(fut)
+            self._pending += 1
             self._wake.notify()
         fut["event"].wait()
         if fut["error"] is not None:
@@ -99,6 +103,7 @@ class BatchingGrvProxy:
                 if self._closed:
                     pending = self._queues["default"] + self._queues["batch"]
                     self._queues = {"default": [], "batch": []}
+                    self._pending = 0
                     for fut in pending:
                         fut["error"] = err("process_behind")
                         fut["event"].set()
@@ -120,6 +125,8 @@ class BatchingGrvProxy:
             rk = self.inner.ratekeeper
             version = None  # ONE committed-version read per grant round
             granted_any = False
+            round_granted = 0
+            resolved = 0  # granted + aged-out: leave the _pending count
             for qkey in ("default", "batch"):
                 queue = work[qkey]
                 # strict FIFO: grant from the head until the first denial
@@ -133,11 +140,12 @@ class BatchingGrvProxy:
                     if version is None:
                         version = self.inner.sequencer.committed_version
                         self.batches_granted += 1
-                    self.inner.grv_count += 1
                     fut["value"] = version
                     fut["event"].set()
                     n_granted += 1
                     granted_any = True
+                round_granted += n_granted
+                resolved += n_granted
                 rest = queue[n_granted:]
                 if not rest:
                     continue
@@ -147,6 +155,7 @@ class BatchingGrvProxy:
                     if now - fut["born"] > self.max_wait_s:
                         fut["error"] = err("process_behind")
                         fut["event"].set()
+                        resolved += 1
                     else:
                         if not fut["waited"]:
                             fut["waited"] = True
@@ -155,6 +164,10 @@ class BatchingGrvProxy:
                 if keep:
                     with self._lock:  # requeue AT FRONT: FIFO preserved
                         self._queues[qkey] = keep + self._queues[qkey]
+            with self._lock:
+                self.inner.grv_count += round_granted
+                self._pending -= resolved
+                self.max_round = max(self.max_round, round_granted)
             # throttled rounds back off exponentially (cap 20ms) instead
             # of hammering the bucket every half millisecond
             sleep_s = (

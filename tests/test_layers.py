@@ -208,3 +208,177 @@ def test_tenant_directory_inside(db):
     org.run(lambda tr: tr.set(d.pack((1,)), b"mail"))
     assert org.run(lambda tr: tr.get(d.pack((1,)))) == b"mail"
     assert db.get(d.pack((1,))) is None  # invisible outside the tenant
+
+
+def fresh_db():
+    return Cluster(resolver_backend="cpu").database()
+
+
+class TestDirectoryPartition:
+    """Ref: DirectoryPartition in bindings/python/fdb/directory_impl.py —
+    layer=b'partition' creates an isolated sub-hierarchy with its own
+    node subspace and allocator, movable/removable as one unit."""
+
+    def test_create_and_isolation(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def fn(tr):
+            part = dl.create(tr, "tenant-a", layer=b"partition")
+            inner = part.create_or_open(tr, "table")
+            tr.set(inner.pack((1,)), b"row")
+            outer = dl.create_or_open(tr, "plain")
+            return part, inner, outer
+
+        part, inner, outer = db.run(fn)
+        assert repr(part).startswith("DirectoryPartition")
+        # the inner directory's prefix lives INSIDE the partition's
+        assert inner.raw_prefix.startswith(part.raw_prefix)
+        assert not outer.raw_prefix.startswith(part.raw_prefix)
+        # child metadata (node subspace) is inside the partition too
+        assert db.run(lambda tr: part.list(tr)) == ["table"]
+        assert db.run(lambda tr: dl.list(tr)) == ["plain", "tenant-a"]
+        # reopening resolves back to a partition
+        reopened = db.run(lambda tr: dl.open(tr, "tenant-a"))
+        assert repr(reopened).startswith("DirectoryPartition")
+        assert db.run(lambda tr: reopened.open(tr, "table")).raw_prefix \
+            == inner.raw_prefix
+
+    def test_partition_is_not_a_subspace(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+        part = db.run(lambda tr: dl.create(tr, "p", layer=b"partition"))
+        with pytest.raises(ValueError):
+            part.pack((1,))
+        with pytest.raises(ValueError):
+            part.key()
+        with pytest.raises(ValueError):
+            part.range()
+        with pytest.raises(ValueError):
+            part[b"x"]
+
+    def test_remove_partition_removes_everything(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def setup(tr):
+            part = dl.create(tr, "p", layer=b"partition")
+            inner = part.create_or_open(tr, "t")
+            tr.set(inner.pack((1,)), b"row")
+            return part, inner
+
+        part, inner = db.run(setup)
+        assert db.get(inner.pack((1,))) == b"row"
+        db.run(lambda tr: part.remove(tr))
+        assert not db.run(lambda tr: dl.exists(tr, "p"))
+        assert db.get(inner.pack((1,))) is None  # contents gone too
+
+    def test_move_partition_as_unit(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def setup(tr):
+            part = dl.create(tr, "old", layer=b"partition")
+            inner = part.create_or_open(tr, "t")
+            tr.set(inner.pack((1,)), b"row")
+            return part, inner
+
+        part, inner = db.run(setup)
+        db.run(lambda tr: part.move_to(tr, ("new",)))
+        assert not db.run(lambda tr: dl.exists(tr, "old"))
+        moved = db.run(lambda tr: dl.open(tr, "new"))
+        # prefixes (and therefore data) are untouched by the move
+        assert db.run(lambda tr: moved.open(tr, "t")).raw_prefix \
+            == inner.raw_prefix
+        assert db.get(inner.pack((1,))) == b"row"
+
+    def test_partition_allocator_independent(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def fn(tr):
+            part = dl.create(tr, "p", layer=b"partition")
+            a = part.create_or_open(tr, "a")
+            b = part.create_or_open(tr, "b")
+            return part, a, b
+
+        part, a, b = db.run(fn)
+        assert a.raw_prefix != b.raw_prefix
+        assert a.raw_prefix.startswith(part.raw_prefix)
+        assert b.raw_prefix.startswith(part.raw_prefix)
+
+
+def test_status_json_depth():
+    """Ref: Status.actor.cpp — processes/roles, qos, data sections."""
+    from foundationdb_tpu.server.cluster import Cluster
+    from tests.conftest import TEST_KNOBS
+
+    c = Cluster(n_storage=2, n_tlogs=3, **TEST_KNOBS)
+    db = c.database()
+    db[b"k"] = b"v"
+    st = c.status()["cluster"]
+    assert st["database_available"] and not st["degraded"]
+    assert st["processes"]["logs"] == {
+        "count": 3, "live": 3, "quorum": 2, "replicated": True}
+    assert len(st["processes"]["storage_servers"]) == 2
+    assert st["processes"]["resolvers"][0]["alive"]
+    assert st["qos"]["transactions_per_second_limit"] > 0
+    assert st["data"]["replication_factor"] == 2
+    c.storages[0].kill()
+    st = c.status()["cluster"]
+    assert st["degraded"]
+    assert not st["processes"]["storage_servers"][0]["alive"]
+    c.detect_and_recruit()
+    st = c.status()["cluster"]
+    assert not st["degraded"] and st["recruitments"] == 1
+
+
+class TestPartitionRouting:
+    """Paths that traverse a partition route to its own hierarchy
+    transparently; cross-partition moves are refused (round-2 review:
+    parent-layer traversal previously either failed or silently broke
+    the partition's isolation)."""
+
+    def test_parent_paths_route_into_partition(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def setup(tr):
+            part = dl.create(tr, "p", layer=b"partition")
+            inner = part.create_or_open(tr, "table")
+            return part, inner
+
+        part, inner = db.run(setup)
+        # absolute open through the parent resolves the same directory
+        via_parent = db.run(lambda tr: dl.open(tr, ("p", "table")))
+        assert via_parent.raw_prefix == inner.raw_prefix
+        # create through the parent allocates INSIDE the partition
+        other = db.run(lambda tr: dl.create_or_open(tr, ("p", "other")))
+        assert other.raw_prefix.startswith(part.raw_prefix)
+        assert db.run(lambda tr: dl.list(tr, "p")) == ["other", "table"]
+        assert db.run(lambda tr: dl.exists(tr, ("p", "other")))
+        assert db.run(lambda tr: dl.remove(tr, ("p", "other")))
+        assert not db.run(lambda tr: part.exists(tr, "other"))
+
+    def test_cross_partition_moves_refused(self):
+        db = fresh_db()
+        dl = DirectoryLayer()
+
+        def setup(tr):
+            dl.create(tr, "p", layer=b"partition")
+            dl.create(tr, "q", layer=b"partition")
+            dl.create_or_open(tr, "plain")
+            dl.create_or_open(tr, ("p", "inside"))
+
+        db.run(setup)
+        for old, new in (
+            ("plain", ("p", "x")),       # into a partition
+            (("p", "inside"), ("out",)),  # out of a partition
+            (("p", "inside"), ("q", "x")),  # between partitions
+        ):
+            with pytest.raises(ValueError, match="between directory"):
+                db.run(lambda tr, o=old, n=new: dl.move(tr, o, n))
+        # moves WITHIN one partition still work, via the parent layer
+        moved = db.run(lambda tr: dl.move(tr, ("p", "inside"), ("p", "in2")))
+        assert db.run(lambda tr: dl.exists(tr, ("p", "in2")))
+        assert not db.run(lambda tr: dl.exists(tr, ("p", "inside")))
