@@ -211,10 +211,15 @@ class DirectoryPartition(Directory):
             tr, _to_path(old_path), _to_path(new_path)
         )
 
-    def move_to(self, tr, new_absolute_path):
-        # relocating the partition itself happens in the parent hierarchy
+    def move_to(self, tr, new_path_in_parent):
+        """Relocate the partition itself within its PARENT hierarchy —
+        the path is relative to the hierarchy the partition lives in
+        (for a top-level partition that is the root layer; for a nested
+        one, the enclosing partition). A partition can never move into a
+        different hierarchy: its content prefix is a byte range of the
+        parent's allocator."""
         return self._parent_layer.move(
-            tr, self._path, _to_path(new_absolute_path)
+            tr, self._path, _to_path(new_path_in_parent)
         )
 
     # ── a partition is not a content subspace (ref: the bindings raise) ──

@@ -34,7 +34,7 @@ class Cluster:
                  coordination=None, n_coordinators=3, coordination_dir=None,
                  replication=None, commit_pipeline="sync",
                  commit_batch_max=None, commit_flush_after=4,
-                 target_tps=None, rk_clock=None, n_tlogs=1,
+                 target_tps=None, rk_clock=None, n_tlogs=1, fsync=False,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -109,10 +109,14 @@ class Cluster:
         TraceEvent("MasterRecovered").detail(
             generation=self.generation, version=recovered).log()
 
+        # fsync=True: every tlog push reaches the platters before the
+        # commit acks (ref: TLog's DiskQueue fsync — the reference's
+        # durability default; ours is opt-in because sim/test runs pay
+        # ~10ms per commit for it)
         if n_tlogs > 1:
-            self.tlog = TLogSystem(n_tlogs, wal_path=wal_path)
+            self.tlog = TLogSystem(n_tlogs, wal_path=wal_path, fsync=fsync)
         else:
-            self.tlog = TLog(wal_path=wal_path)
+            self.tlog = TLog(wal_path=wal_path, fsync=fsync)
         self.tlog._first_version = recovered
         self.sequencer = Sequencer(
             version_clock=version_clock, start_version=recovered
@@ -289,6 +293,21 @@ class Cluster:
         self.persist_shard_map()
         self.commit_proxy.update_resolver_ranges()
         return moves
+
+    def exclude_storage(self, sid):
+        """Begin draining a storage (ref: fdbcli exclude → the excluded-
+        servers system key → DD relocating its shards). Reads stop
+        routing new work there once its last shard moves; poll
+        ``storage_drained`` to learn when removal is safe."""
+        self.dd.excluded.add(sid)
+        return self.rebalance()
+
+    def include_storage(self, sid):
+        """Cancel an exclusion (ref: fdbcli include)."""
+        self.dd.excluded.discard(sid)
+
+    def storage_drained(self, sid):
+        return self.dd.storage_owns_nothing(sid)
 
     def persist_shard_map(self):
         """Write the live shard map to \\xff/keyServers/ through the

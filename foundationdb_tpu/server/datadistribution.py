@@ -117,6 +117,40 @@ class DataDistributor:
         )
         self.max_shard_bytes = max_shard_bytes
         self.min_shard_bytes = min_shard_bytes
+        self.excluded = set()  # storages being drained (ref: fdbcli exclude)
+
+    def storage_owns_nothing(self, sid):
+        """True when no shard's team includes sid — safe to remove."""
+        return all(sid not in team for team in self.map.teams)
+
+    def drain_excluded(self):
+        """Relocate every shard off excluded storages (ref: DD honoring
+        the excluded-servers list: exclusion drains, then the operator
+        removes the process). Returns the moves performed this round;
+        callers poll storage_owns_nothing to learn when a drain is done."""
+        moves = []
+        for i, team in enumerate(list(self.map.teams)):
+            bad = [s for s in team if s in self.excluded]
+            if not bad:
+                continue
+            load = self.team_bytes()
+            candidates = sorted(
+                (
+                    s for s in range(len(self.storages))
+                    if s not in team and s not in self.excluded
+                    and self.storages[s].alive
+                ),
+                key=load.__getitem__,
+            )
+            if len(candidates) < len(bad):
+                continue  # not enough healthy storages; drain stalls
+            new_team = [
+                s if s not in self.excluded else candidates.pop(0)
+                for s in team
+            ]
+            if self._relocate(i, team, new_team):
+                moves.append((self.map.shard_range(i), team, new_team))
+        return moves
 
     def note_write(self, key, nbytes):
         i = self.map.shard_index(key)
@@ -138,6 +172,7 @@ class DataDistributor:
         moves = []
         self._split_large()
         self._merge_small()
+        moves.extend(self.drain_excluded())
         moves.extend(self._move_for_balance())
         return moves
 
@@ -194,7 +229,15 @@ class DataDistributor:
         for _ in range(2):  # bounded moves per round, like DD's queue
             load = self.team_bytes()
             hot = max(range(len(load)), key=load.__getitem__)
-            cold = min(range(len(load)), key=load.__getitem__)
+            # coldest NON-excluded candidate: a draining storage reads 0
+            # bytes and would otherwise be the global min forever,
+            # stalling balancing for every healthy storage
+            eligible = [
+                s for s in range(len(load)) if s not in self.excluded
+            ]
+            if len(eligible) < 2:
+                break
+            cold = min(eligible, key=load.__getitem__)
             diff = load[hot] - load[cold]
             if diff < self.max_shard_bytes:
                 break

@@ -125,6 +125,8 @@ class Cli:
             "  getversion                      current read version",
             "  status [json]                   cluster status",
             "  tenant create|delete|list|get   manage tenants",
+            "  exclude [ID]                    drain a storage (list with no arg)",
+            "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
             "  exit / quit",
         )
@@ -231,6 +233,37 @@ class Cli:
             f"Generation: {c['generation']}",
             f"Latest version: {c['latest_version']}",
         )
+
+    def _cmd_exclude(self, args):
+        """Ref: fdbcli exclude — drain a storage so it can be removed."""
+        cluster = self.db._cluster
+        if not args:
+            ex = sorted(cluster.dd.excluded)
+            if not ex:
+                self._p("No storages are excluded.")
+            for sid in ex:
+                state = "drained" if cluster.storage_drained(sid) else "draining"
+                self._p(f"  storage {sid}: {state}")
+            return
+        sid = int(args[0])
+        if not 0 <= sid < len(cluster.storages):
+            self._p(f"ERROR: no storage {sid}")
+            return
+        cluster.exclude_storage(sid)
+        state = "drained" if cluster.storage_drained(sid) else "draining"
+        self._p(f"Storage {sid} excluded ({state}).")
+
+    def _cmd_include(self, args):
+        cluster = self.db._cluster
+        if not args:
+            self._p("ERROR: include requires a storage id")
+            return
+        sid = int(args[0])
+        if not 0 <= sid < len(cluster.storages):
+            self._p(f"ERROR: no storage {sid}")
+            return
+        cluster.include_storage(sid)
+        self._p(f"Storage {sid} included.")
 
     def _cmd_option(self, args):
         self._p("Option enabled for all transactions")

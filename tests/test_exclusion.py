@@ -1,0 +1,100 @@
+"""Storage exclusion/draining (ref: fdbcli exclude + DataDistribution's
+excluded-servers handling): an excluded storage's shards relocate to
+healthy peers; once it owns nothing it is safe to remove, and reads
+never break during the drain."""
+
+import pytest
+
+from foundationdb_tpu.server.cluster import Cluster
+from tests.conftest import TEST_KNOBS
+
+
+@pytest.fixture()
+def partitioned():
+    c = Cluster(n_storage=4, replication=2, **TEST_KNOBS)
+    m = c.dd.map
+    m.split(0, b"g"); m.split(1, b"n"); m.split(2, b"t")
+    m.assign(0, [0, 1]); m.assign(1, [1, 2])
+    m.assign(2, [2, 3]); m.assign(3, [3, 0])
+    db = c.database()
+    for k in (b"alpha", b"golf", b"mike", b"november", b"tango", b"zulu"):
+        db.set(k, b"v-" + k)
+    return c, db
+
+
+def test_exclude_drains_and_preserves_reads(partitioned):
+    c, db = partitioned
+    assert not c.storage_drained(1)  # owns shards 0 and 1
+    c.exclude_storage(1)
+    assert c.storage_drained(1), "drain did not complete in one round"
+    assert all(1 not in team for team in c.dd.map.teams)
+    # every key still readable, replication preserved
+    for k in (b"alpha", b"golf", b"mike", b"november", b"tango", b"zulu"):
+        assert db.get(k) == b"v-" + k
+    assert all(len(set(t)) == 2 for t in c.dd.map.teams)
+    # new writes never land on the drained storage (its stale copy
+    # lingers until cleanup, like the reference's lazy data removal)
+    db.set(b"golf", b"v2")
+    assert c.storages[1].get(b"golf", c.storages[1].version) != b"v2"
+    # safe removal: killing the drained storage degrades nothing
+    c.storages[1].kill()
+    assert db.get(b"golf") == b"v2"
+
+
+def test_rebalance_never_fills_excluded_but_still_balances(partitioned):
+    c, db = partitioned
+    c.dd.max_shard_bytes = 2000
+    c.exclude_storage(3)
+    assert c.storage_drained(3)
+    owned_before = {i for i, t in enumerate(c.dd.map.teams) if 3 in t}
+    assert not owned_before
+    # skew load heavily, then rebalance: the drained storage (0 bytes,
+    # always the global min) must be SKIPPED as a cold target — and must
+    # not stall balancing among the healthy storages (round-2 review:
+    # a bare `break` froze all load balancing while any exclusion existed)
+    for i in range(80):
+        db.set(b"a%03d" % i, b"x" * 100)
+    moves = c.rebalance()
+    assert all(
+        3 not in t for t in c.dd.map.teams
+    ), "rebalance moved a shard onto the excluded storage"
+    assert moves, "balancing stalled while an exclusion existed"
+
+
+def test_include_cancels_drain(partitioned):
+    c, db = partitioned
+    c.dd.excluded.add(0)
+    c.include_storage(0)
+    assert 0 not in c.dd.excluded
+
+
+def test_drain_stalls_without_capacity():
+    """With nowhere to move shards (all other storages excluded or dead),
+    the drain stalls rather than dropping below replication."""
+    c = Cluster(n_storage=2, replication=2, **TEST_KNOBS)
+    db = c.database()
+    db.set(b"k", b"v")
+    c.exclude_storage(0)
+    assert not c.storage_drained(0)  # no healthy destination exists
+    assert db.get(b"k") == b"v"
+
+
+def test_cli_exclude_include():
+    import io
+
+    from foundationdb_tpu.tools.cli import Cli
+
+    c = Cluster(n_storage=4, replication=2, **TEST_KNOBS)
+    m = c.dd.map
+    m.split(0, b"m"); m.assign(0, [0, 1]); m.assign(1, [2, 3])
+    db = c.database()
+    db.set(b"a", b"1")
+    out = io.StringIO()
+    cli = Cli(db, out=out)
+    cli.run_command("exclude")
+    assert "No storages are excluded" in out.getvalue()
+    cli.run_command("exclude 0")
+    assert "Storage 0 excluded (drained)" in out.getvalue()
+    cli.run_command("include 0")
+    assert "Storage 0 included." in out.getvalue()
+    assert 0 not in c.dd.excluded

@@ -382,3 +382,27 @@ class TestPartitionRouting:
         moved = db.run(lambda tr: dl.move(tr, ("p", "inside"), ("p", "in2")))
         assert db.run(lambda tr: dl.exists(tr, ("p", "in2")))
         assert not db.run(lambda tr: dl.exists(tr, ("p", "inside")))
+
+
+def test_nested_partition_move_to_is_parent_relative():
+    """move_to relocates the partition within its PARENT hierarchy —
+    for a nested partition, that is the enclosing partition's layer
+    (round-2 review: absolute-from-root paths were a guaranteed error)."""
+    db = fresh_db()
+    dl = DirectoryLayer()
+
+    def setup(tr):
+        p = dl.create(tr, "p", layer=b"partition")
+        q = p.create_or_open(tr, "q", layer=b"partition")
+        inner = q.create_or_open(tr, "t")
+        tr.set(inner.pack((1,)), b"row")
+        return p, q, inner
+
+    p, q, inner = db.run(setup)
+    db.run(lambda tr: q.move_to(tr, ("q2",)))  # within p's hierarchy
+    assert not db.run(lambda tr: p.exists(tr, "q"))
+    moved = db.run(lambda tr: p.open(tr, "q2"))
+    assert repr(moved).startswith("DirectoryPartition")
+    assert db.run(lambda tr: moved.open(tr, "t")).raw_prefix \
+        == inner.raw_prefix
+    assert db.get(inner.pack((1,))) == b"row"
