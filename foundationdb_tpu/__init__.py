@@ -17,13 +17,23 @@ __version__ = "0.1.0"
 
 
 def open(cluster_file=None, **kw):
-    """Open an in-process cluster and return a Database handle.
+    """Open a database and return a Database handle.
 
-    Ref parity: fdb.open() in bindings/python/fdb/__init__.py. There is no
-    external fdbserver process here; the cluster (sequencer, proxies,
-    resolver, tlogs, storage) runs in-process with the resolver kernel on
-    the default JAX device.
+    Ref parity: fdb.open() in bindings/python/fdb/__init__.py. With a
+    ``cluster_file`` (or an ``address="host:port"`` kwarg) the client
+    connects over the RPC transport to an fdbserver-style process
+    (tools/fdbserver.py). Without one, the cluster (sequencer, proxies,
+    resolver, tlogs, storage) runs in-process with the resolver kernel
+    on the default JAX device.
     """
+    if cluster_file is not None or "address" in kw:
+        from foundationdb_tpu.rpc.service import RemoteCluster
+
+        if cluster_file is not None:
+            remote = RemoteCluster.from_cluster_file(cluster_file, **kw)
+        else:
+            remote = RemoteCluster(kw.pop("address"), **kw)
+        return remote.database()
     from foundationdb_tpu.server.cluster import Cluster
 
     cluster = Cluster(**kw)

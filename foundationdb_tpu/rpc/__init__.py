@@ -1,0 +1,13 @@
+"""fdbrpc analog: wire serialization, framed transport, endpoints, and
+the client↔server process model (ref: fdbrpc/FlowTransport.actor.cpp,
+fdbrpc/fdbrpc.h). The deterministic simulation keeps its own in-process
+message model (sim/network.py); this package is the REAL network."""
+
+from foundationdb_tpu.rpc.service import (  # noqa: F401
+    ClusterService,
+    RemoteCluster,
+    parse_cluster_file,
+    serve_cluster,
+    write_cluster_file,
+)
+from foundationdb_tpu.rpc.transport import RpcClient, RpcServer  # noqa: F401

@@ -1,0 +1,376 @@
+"""Cluster service endpoints + the remote client.
+
+Ref parity: the client↔server split in FoundationDB — fdbclient's
+NativeAPI speaks to fdbserver processes found through the cluster file
+(fdbclient/ClusterConnectionFile, MonitorLeader). Here `ClusterService`
+exposes a running `server.cluster.Cluster`'s role interfaces as RPC
+endpoints, and `RemoteCluster` implements the exact cluster surface
+`txn/transaction.py` consumes (grv_proxy / read_storage / commit_proxy /
+knobs / status), so `Database(RemoteCluster(...))` IS the remote client —
+the whole transaction, layer, and directory stack runs against a real
+network without a line of change.
+
+Failure semantics on a dead connection (ref: NativeAPI's handling of
+broken proxy connections):
+- reads / GRVs: retry on a fresh connection; if no server is reachable
+  the error surfaces as `transaction_too_old`-style retryable only after
+  reconnect succeeds — otherwise ConnectionLost propagates (the cluster
+  is gone, not the transaction).
+- commit: NEVER auto-retried at this layer. A connection that dies with
+  a commit outstanding returns `commit_unknown_result` (1021) — the
+  transaction may or may not have committed, exactly the reference's
+  contract; the client retry loop owns the disambiguation.
+"""
+
+import dataclasses
+import itertools
+import os
+import random
+import string
+import threading
+import time
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.options import Knobs
+from foundationdb_tpu.rpc.transport import (
+    ConnectionLost,
+    RpcServer,
+    connect_any,
+)
+from foundationdb_tpu.rpc.wire import PROTOCOL_VERSION
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+# ───────────────────────────── cluster files ─────────────────────────────
+def write_cluster_file(path, addresses, description="tpu", cluster_id=None):
+    """``description:id@host:port,host:port`` (ref: ClusterConnectionFile
+    format in fdbclient/ConnectionString)."""
+    if cluster_id is None:
+        cluster_id = "".join(
+            random.choice(string.ascii_lowercase + string.digits)
+            for _ in range(8)
+        )
+    body = f"{description}:{cluster_id}@{','.join(addresses)}\n"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, path)
+    return body.strip()
+
+
+def parse_cluster_file(path):
+    """Returns (description, cluster_id, [addresses])."""
+    with open(path) as f:
+        line = f.read().strip()
+    head, _, addrs = line.partition("@")
+    desc, _, cid = head.partition(":")
+    addresses = [a.strip() for a in addrs.split(",") if a.strip()]
+    if not addresses:
+        raise ValueError(f"cluster file {path!r} has no addresses: {line!r}")
+    return desc, cid, addresses
+
+
+# ───────────────────────────── server side ───────────────────────────────
+class ClusterService:
+    """Endpoint table over a live Cluster (the fdbserver worker's RPC
+    surface). One instance per served cluster; handlers are thread-safe
+    to the same degree the underlying roles are (thread-mode clusters
+    take their own locks)."""
+
+    WATCH_TTL_S = 900  # orphaned watches (client gone) age out
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._watches = {}  # watch_id -> (Watch, threading.Event, born)
+        self._watch_ids = itertools.count(1)
+        self._watch_lock = threading.Lock()
+
+    def handlers(self):
+        return {
+            "hello": self.hello,
+            "knobs": self.knobs,
+            "status": self.status,
+            "get_read_version": self.get_read_version,
+            "storage_get": self.storage_get,
+            "resolve_selector": self.resolve_selector,
+            "get_range": self.get_range,
+            "commit": self.commit,
+            "watch_register": self.watch_register,
+            "watch_poll": self.watch_poll,
+            "watch_wait": self.watch_wait,
+        }
+
+    def hello(self, client_protocol):
+        if client_protocol != PROTOCOL_VERSION:
+            raise FDBError.from_name("incompatible_protocol_version")
+        return {
+            "protocol": PROTOCOL_VERSION,
+            "generation": self.cluster.generation,
+        }
+
+    def knobs(self):
+        return dataclasses.asdict(self.cluster.knobs)
+
+    def status(self):
+        return self.cluster.status()
+
+    def get_read_version(self):
+        return self.cluster.grv_proxy.get_read_version()
+
+    def storage_get(self, key, rv):
+        return self.cluster.read_storage(key).get(key, rv)
+
+    def resolve_selector(self, selector, rv):
+        return self.cluster.read_storage().resolve_selector(selector, rv)
+
+    def get_range(self, begin, end, rv, limit, reverse):
+        rows = self.cluster.read_storage().get_range(
+            begin, end, rv, limit=limit, reverse=reverse
+        )
+        return [(k, v) for k, v in rows]
+
+    def commit(self, request):
+        # the proxy returns (never raises) FDBError verdicts; the wire
+        # carries them as values so the client transaction sees the exact
+        # in-process contract
+        return self.cluster.commit_proxy.commit(request)
+
+    def watch_register(self, key, seen_value):
+        w = self.cluster.read_storage(key).watch(key, seen_value)
+        fired = threading.Event()
+        w.on_fire(fired.set)
+        # on_fire's fired-check and its callback append are not atomic
+        # against a concurrent commit's _fire (which runs on another pool
+        # thread): re-checking after registration closes the window where
+        # _fire iterated the callback list before ours landed
+        if w.fired:
+            fired.set()
+        wid = next(self._watch_ids)
+        now = time.monotonic()
+        with self._watch_lock:
+            self._watches[wid] = (w, fired, now)
+            if len(self._watches) % 256 == 0:
+                self._sweep_locked(now)
+        return wid
+
+    def _sweep_locked(self, now):
+        """Drop aged-out watches whose client never came back for them —
+        they pin both this registry and storage._watches forever
+        otherwise (a disconnect leaves no signal at this layer)."""
+        dead = [
+            wid for wid, (_, _, born) in self._watches.items()
+            if now - born > self.WATCH_TTL_S
+        ]
+        for wid in dead:
+            del self._watches[wid]
+
+    def _watch_fired(self, entry):
+        w, fired, _ = entry
+        return w.fired or fired.is_set()
+
+    def watch_poll(self, wid):
+        with self._watch_lock:
+            entry = self._watches.get(wid)
+            if entry is None:
+                return True  # forgotten watches count as fired (re-read)
+            if self._watch_fired(entry):
+                del self._watches[wid]  # one-shot, like the reference
+                return True
+        return False
+
+    def watch_wait(self, wid, timeout):
+        with self._watch_lock:
+            entry = self._watches.get(wid)
+        if entry is None:
+            return True
+        entry[1].wait(timeout=timeout)
+        if self._watch_fired(entry):
+            with self._watch_lock:
+                self._watches.pop(wid, None)
+            return True
+        return False
+
+
+def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16):
+    """Expose a cluster on the network; returns the RpcServer."""
+    service = ClusterService(cluster)
+    server = RpcServer(host, port, service.handlers(), max_workers=max_workers)
+    TraceEvent("RpcServerStarted").detail(address=server.address).log()
+    return server
+
+
+# ───────────────────────────── client side ───────────────────────────────
+class _RemoteWatch:
+    """Client handle satisfying the Watch surface _WatchHandle polls."""
+
+    __slots__ = ("_rc", "_wid", "_fired")
+
+    def __init__(self, rc, wid):
+        self._rc = rc
+        self._wid = wid
+        self._fired = False
+
+    @property
+    def fired(self):
+        if not self._fired:
+            try:
+                self._fired = bool(self._rc._call("watch_poll", self._wid))
+            except ConnectionLost:
+                # server gone: treat as fired so the waiter re-reads (and
+                # gets the real error from the read path)
+                self._fired = True
+        return self._fired
+
+    def wait_remote(self, timeout=None):
+        """Block until fired, in bounded server-side chunks (a pool worker
+        on the server blocks for at most CHUNK_S per RPC, so parked
+        watches cannot starve the handler pool)."""
+        CHUNK_S = 5.0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._fired:
+            chunk = CHUNK_S
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                chunk = min(chunk, remaining)
+            try:
+                self._fired = bool(
+                    self._rc._call("watch_wait", self._wid, chunk)
+                )
+            except ConnectionLost:
+                self._fired = True  # server gone: re-read via the read path
+        return True
+
+
+class _RemoteGrvProxy:
+    __slots__ = ("_rc",)
+
+    def __init__(self, rc):
+        self._rc = rc
+
+    def get_read_version(self):
+        return self._rc._call("get_read_version")
+
+
+class _RemoteCommitProxy:
+    __slots__ = ("_rc",)
+
+    def __init__(self, rc):
+        self._rc = rc
+
+    def commit(self, request):
+        try:
+            return self._rc._call_once("commit", request)
+        except ConnectionLost:
+            # the request may have reached the server: 1021, not a retry
+            return FDBError.from_name("commit_unknown_result")
+
+
+class _RemoteStorage:
+    """Read-side surface (router analog) over the wire."""
+
+    __slots__ = ("_rc",)
+
+    def __init__(self, rc):
+        self._rc = rc
+
+    def get(self, key, rv):
+        return self._rc._call("storage_get", key, rv)
+
+    def resolve_selector(self, selector, rv):
+        return self._rc._call("resolve_selector", selector, rv)
+
+    def get_range(self, begin, end, rv, limit=0, reverse=False):
+        return self._rc._call("get_range", begin, end, rv, limit, reverse)
+
+    def watch(self, key, seen_value):
+        wid = self._rc._call("watch_register", key, seen_value)
+        return _RemoteWatch(self._rc, wid)
+
+
+class RemoteCluster:
+    """The client-side cluster: same attribute surface as
+    server.cluster.Cluster, every role call an RPC."""
+
+    def __init__(self, addresses, connect_timeout=5.0):
+        if isinstance(addresses, str):
+            addresses = [addresses]
+        self.addresses = list(addresses)
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._client = None
+        self._knobs = None
+        self.grv_proxy = _RemoteGrvProxy(self)
+        self.commit_proxy = _RemoteCommitProxy(self)
+        self._storage = _RemoteStorage(self)
+        self._connect()
+
+    @classmethod
+    def from_cluster_file(cls, path, **kw):
+        _, _, addresses = parse_cluster_file(path)
+        return cls(addresses, **kw)
+
+    def _connect(self):
+        with self._lock:
+            if self._client is not None and self._client.alive:
+                return self._client
+            if self._client is not None:
+                self._client.close()  # release the dead socket's fd
+            self._client = connect_any(self.addresses, self._connect_timeout)
+            hello = self._client.call("hello", PROTOCOL_VERSION)
+            generation = hello["generation"]
+            prior = getattr(self, "server_generation", None)
+            if prior is not None and generation != prior:
+                # the cluster recovered behind our back: cached knobs may
+                # be stale. Read versions pinned before the recovery need
+                # no client-side fencing — the recovered storage rejects
+                # them TOO_OLD server-side.
+                self._knobs = None
+                TraceEvent("ClusterGenerationChanged").detail(
+                    old=prior, new=generation).log()
+            self.server_generation = generation
+            return self._client
+
+    def _call_once(self, method, *args):
+        """One attempt, no reconnect — the commit path's no-double-send
+        rule."""
+        client = self._client
+        if client is None or not client.alive:
+            client = self._connect()
+        try:
+            return client.call(method, *args)
+        except (ConnectionLost, OSError) as e:
+            raise ConnectionLost(str(e)) from e
+
+    def _call(self, method, *args):
+        """Idempotent call: one transparent reconnect+retry (reads, GRVs,
+        watches are all safe to re-send)."""
+        try:
+            return self._call_once(method, *args)
+        except ConnectionLost:
+            self._connect()  # raises ConnectionLost if nobody is reachable
+            return self._call_once(method, *args)
+
+    @property
+    def knobs(self):
+        if self._knobs is None:
+            self._knobs = Knobs(**self._call("knobs"))
+        return self._knobs
+
+    def read_storage(self, key=b""):
+        return self._storage
+
+    def status(self):
+        return self._call("status")
+
+    def database(self):
+        from foundationdb_tpu.txn.database import Database
+
+        return Database(self)
+
+    def close(self):
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None

@@ -1,0 +1,281 @@
+"""Framed TCP transport with multiplexed request/reply endpoints.
+
+Ref parity: fdbrpc/FlowTransport.actor.cpp — connections carry
+length-prefixed packets addressed to endpoint tokens; replies are matched
+to requests by id; a connection failure fails every outstanding request
+on it. The reference multiplexes actor futures over one socket per peer;
+here a reader thread per connection completes `concurrent.futures`
+futures, and server handlers run on a shared pool so a blocking endpoint
+(a watch wait, a batched GRV) never stalls the socket.
+
+Frame: 4-byte big-endian length + wire payload.
+Request: ("q", seq, method, args-tuple)  Reply: ("r", seq, ok, payload).
+"""
+
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.rpc import wire
+from foundationdb_tpu.utils.trace import TraceEvent
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class ConnectionLost(ConnectionError):
+    """The peer vanished with requests outstanding."""
+
+
+def _send_frame(sock, lock, payload: bytes):
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)}")
+    msg = struct.pack(">I", len(payload)) + payload
+    with lock:
+        sock.sendall(msg)
+
+
+def _recv_exact(sock, n):
+    parts = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionLost("peer closed")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise ConnectionLost(f"oversized frame: {n}")
+    return _recv_exact(sock, n)
+
+
+class RpcServer:
+    """Listens for connections; dispatches requests to named handlers.
+
+    ``handlers`` is the endpoint table: method name → callable(*args).
+    A handler raising FDBError sends the error to the client intact
+    (the client re-raises it); any other exception becomes a generic
+    remote failure string.
+    """
+
+    def __init__(self, host, port, handlers, max_workers=16):
+        self.handlers = dict(handlers)
+        self._listener = socket.create_server(
+            (host, port), reuse_port=False, backlog=64
+        )
+        self._listener.settimeout(0.2)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="rpc-handler"
+        )
+        self._conns = set()
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rpc-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self):
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while not self._closed.is_set():
+            try:
+                sock, peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(sock)
+            threading.Thread(
+                target=self._serve_conn, args=(sock, peer),
+                name=f"rpc-conn-{peer}", daemon=True,
+            ).start()
+
+    def _serve_conn(self, sock, peer):
+        send_lock = threading.Lock()
+        try:
+            while not self._closed.is_set():
+                frame = _recv_frame(sock)
+                kind, seq, method, args = wire.loads(frame)
+                if kind != "q":
+                    raise ConnectionLost(f"unexpected message kind {kind!r}")
+                self._pool.submit(
+                    self._dispatch, sock, send_lock, seq, method, args
+                )
+        except (ConnectionLost, ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self._conns.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, sock, send_lock, seq, method, args):
+        try:
+            fn = self.handlers.get(method)
+            if fn is None:
+                raise KeyError(f"no such endpoint: {method}")
+            result = fn(*args)
+            reply = wire.dumps(("r", seq, True, result))
+        except FDBError as e:
+            reply = wire.dumps(("r", seq, False, e))
+        except Exception as e:  # generic remote failure
+            reply = wire.dumps(("r", seq, False, f"{type(e).__name__}: {e}"))
+        try:
+            _send_frame(sock, send_lock, reply)
+        except (ConnectionError, OSError):
+            pass  # client vanished; nothing to tell it
+        except ValueError:
+            # reply exceeds MAX_FRAME: the client must still get an answer
+            # or its future hangs forever — send the error instead
+            try:
+                _send_frame(sock, send_lock, wire.dumps((
+                    "r", seq, False,
+                    f"ValueError: reply to {method} exceeds frame limit",
+                )))
+            except (ConnectionError, OSError, ValueError):
+                pass
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=False)
+        self._accept_thread.join(timeout=2)
+
+
+class RemoteError(RuntimeError):
+    """A non-FDBError exception raised inside a remote handler."""
+
+
+class RpcClient:
+    """One connection to an RpcServer; thread-safe, multiplexed calls."""
+
+    def __init__(self, host, port, connect_timeout=5.0):
+        self.host, self.port = host, port
+        self._sock = socket.create_connection((host, port), connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending = {}  # seq -> Future
+        self._seq = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="rpc-client-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                frame = _recv_frame(self._sock)
+                kind, seq, ok, payload = wire.loads(frame)
+                with self._state_lock:
+                    fut = self._pending.pop(seq, None)
+                if fut is None:
+                    continue  # cancelled/timed-out request
+                if ok:
+                    fut.set_result(payload)
+                elif isinstance(payload, FDBError):
+                    fut.set_exception(payload)
+                else:
+                    fut.set_exception(RemoteError(str(payload)))
+        except (ConnectionLost, ConnectionError, OSError, ValueError) as e:
+            self._fail_all(e)
+
+    def _fail_all(self, exc):
+        with self._state_lock:
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        try:
+            self._sock.close()  # no fd leak across reconnect cycles
+        except OSError:
+            pass
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(str(exc)))
+
+    @property
+    def alive(self):
+        return not self._closed
+
+    def call_async(self, method, *args) -> Future:
+        fut = Future()
+        with self._state_lock:
+            if self._closed:
+                raise ConnectionLost("connection closed")
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = fut
+        try:
+            _send_frame(
+                self._sock, self._send_lock,
+                wire.dumps(("q", seq, method, tuple(args))),
+            )
+        except (ConnectionError, OSError) as e:
+            with self._state_lock:
+                self._pending.pop(seq, None)
+            self._fail_all(e)
+            raise ConnectionLost(str(e)) from e
+        except (ValueError, TypeError):
+            # encoding failure / oversized request: the connection is fine,
+            # only this call is bad — don't fail other in-flight requests
+            with self._state_lock:
+                self._pending.pop(seq, None)
+            raise
+        return fut
+
+    def call(self, method, *args, timeout=None):
+        return self.call_async(method, *args).result(timeout=timeout)
+
+    def close(self):
+        with self._state_lock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect_any(addresses, connect_timeout=5.0):
+    """Try each ``host:port`` in turn; first reachable wins (ref: the
+    client walking the coordinator list in the cluster file)."""
+    last = None
+    for addr in addresses:
+        host, _, port = addr.rpartition(":")
+        try:
+            return RpcClient(host, int(port), connect_timeout)
+        except OSError as e:
+            last = e
+    raise ConnectionLost(
+        f"no server reachable among {addresses!r}: {last}"
+    )

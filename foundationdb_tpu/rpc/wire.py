@@ -1,0 +1,180 @@
+"""Wire serialization for the RPC transport.
+
+Ref parity: the role flow's ObjectSerializer / flatbuffers-style wire
+format plays in FlowTransport (flow/ObjectSerializer.h) — every value a
+request or reply can carry has a stable, versioned binary form. The
+format here is a compact tag-byte codec over the concrete types the
+cluster protocol actually moves: primitives, containers, and the four
+protocol structs (Mutation, KeySelector, CommitRequest, FDBError).
+
+Big-endian length prefixes throughout; ints are 8-byte signed with a
+bigint escape so versionstamp-scale values never truncate silently.
+"""
+
+import struct
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.keys import KeySelector
+from foundationdb_tpu.core.mutations import Mutation, Op
+from foundationdb_tpu.server.proxy import CommitRequest
+
+PROTOCOL_VERSION = 1
+
+_OPS = list(Op)
+_OP_INDEX = {op: i for i, op in enumerate(_OPS)}
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _pack_len(buf, b):
+    buf.append(struct.pack(">I", len(b)))
+    buf.append(b)
+
+
+def _enc(buf, v):
+    t = type(v)
+    if v is None:
+        buf.append(b"N")
+    elif t is bool:
+        buf.append(b"T" if v else b"F")
+    elif t is int:
+        if _I64_MIN <= v <= _I64_MAX:
+            buf.append(b"i")
+            buf.append(struct.pack(">q", v))
+        else:
+            raw = v.to_bytes((v.bit_length() + 15) // 8, "big", signed=True)
+            buf.append(b"g")
+            _pack_len(buf, raw)
+    elif t is float:
+        buf.append(b"d")
+        buf.append(struct.pack(">d", v))
+    elif t is bytes:
+        buf.append(b"b")
+        _pack_len(buf, v)
+    elif t is bytearray:
+        buf.append(b"b")
+        _pack_len(buf, bytes(v))
+    elif t is str:
+        buf.append(b"s")
+        _pack_len(buf, v.encode("utf-8"))
+    elif t is list:
+        buf.append(b"l")
+        buf.append(struct.pack(">I", len(v)))
+        for item in v:
+            _enc(buf, item)
+    elif t is tuple:
+        buf.append(b"u")
+        buf.append(struct.pack(">I", len(v)))
+        for item in v:
+            _enc(buf, item)
+    elif t is dict:
+        buf.append(b"m")
+        buf.append(struct.pack(">I", len(v)))
+        for k, val in v.items():
+            _enc(buf, k)
+            _enc(buf, val)
+    elif t is Mutation:
+        buf.append(b"M")
+        buf.append(struct.pack(">B", _OP_INDEX[v.op]))
+        _pack_len(buf, v.key)
+        _enc(buf, v.param)
+    elif t is KeySelector:
+        buf.append(b"K")
+        _pack_len(buf, v.key)
+        buf.append(b"T" if v.or_equal else b"F")
+        buf.append(struct.pack(">i", v.offset))
+    elif t is CommitRequest:
+        buf.append(b"R")
+        _enc(buf, v.read_version)
+        _enc(buf, list(v.mutations))
+        _enc(buf, [(bytes(b_), bytes(e_)) for b_, e_ in v.read_conflict_ranges])
+        _enc(buf, [(bytes(b_), bytes(e_)) for b_, e_ in v.write_conflict_ranges])
+        buf.append(b"T" if v.report_conflicting_keys else b"F")
+    elif isinstance(v, FDBError):
+        buf.append(b"e")
+        buf.append(struct.pack(">I", v.code))
+    else:
+        raise TypeError(f"wire: cannot encode {type(v).__name__}: {v!r}")
+
+
+def dumps(v) -> bytes:
+    buf = []
+    _enc(buf, v)
+    return b"".join(buf)
+
+
+class _Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n):
+        p = self.pos
+        if p + n > len(self.data):
+            raise ValueError("wire: truncated message")
+        self.pos = p + n
+        return self.data[p : p + n]
+
+    def take_len(self):
+        (n,) = struct.unpack(">I", self.take(4))
+        return self.take(n)
+
+
+def _dec(r: _Reader):
+    tag = r.take(1)
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return struct.unpack(">q", r.take(8))[0]
+    if tag == b"g":
+        return int.from_bytes(r.take_len(), "big", signed=True)
+    if tag == b"d":
+        return struct.unpack(">d", r.take(8))[0]
+    if tag == b"b":
+        return r.take_len()
+    if tag == b"s":
+        return r.take_len().decode("utf-8")
+    if tag == b"l":
+        (n,) = struct.unpack(">I", r.take(4))
+        return [_dec(r) for _ in range(n)]
+    if tag == b"u":
+        (n,) = struct.unpack(">I", r.take(4))
+        return tuple(_dec(r) for _ in range(n))
+    if tag == b"m":
+        (n,) = struct.unpack(">I", r.take(4))
+        return {_dec(r): _dec(r) for _ in range(n)}
+    if tag == b"M":
+        (op_i,) = struct.unpack(">B", r.take(1))
+        key = r.take_len()
+        param = _dec(r)
+        return Mutation(_OPS[op_i], key, param)
+    if tag == b"K":
+        key = r.take_len()
+        or_equal = r.take(1) == b"T"
+        (offset,) = struct.unpack(">i", r.take(4))
+        return KeySelector(key, or_equal, offset)
+    if tag == b"R":
+        rv = _dec(r)
+        muts = _dec(r)
+        rcr = _dec(r)
+        wcr = _dec(r)
+        report = r.take(1) == b"T"
+        return CommitRequest(rv, muts, rcr, wcr, report)
+    if tag == b"e":
+        (code,) = struct.unpack(">I", r.take(4))
+        return FDBError(code)
+    raise ValueError(f"wire: unknown tag {tag!r}")
+
+
+def loads(data: bytes):
+    r = _Reader(data)
+    v = _dec(r)
+    if r.pos != len(data):
+        raise ValueError("wire: trailing bytes")
+    return v

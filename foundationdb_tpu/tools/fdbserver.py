@@ -1,0 +1,101 @@
+"""fdbserver-shaped process entry: host a cluster behind the RPC
+transport.
+
+Ref parity: fdbserver/fdbserver.actor.cpp's worker process — started
+with a listen address and a data directory, it serves the database to
+any client holding the cluster file. Role topology (storage count,
+resolvers, tlog replicas, replication factor) is configured by flags the
+way the reference's is configured through the cluster.
+
+Usage::
+
+    python -m foundationdb_tpu.tools.fdbserver \
+        --listen 127.0.0.1:4500 --dir /var/db --cluster-file fdb.cluster
+
+The cluster file is (re)written with this server's address on startup,
+so `foundationdb_tpu.open(cluster_file=...)` finds it.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from foundationdb_tpu.rpc.service import serve_cluster, write_cluster_file
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.utils.trace import TraceEvent
+
+
+def build_cluster(args):
+    kw = {}
+    if args.dir:
+        os.makedirs(args.dir, exist_ok=True)
+        kw["wal_path"] = os.path.join(args.dir, "tlog.wal")
+        kw["coordination_dir"] = os.path.join(args.dir, "coordination")
+    return Cluster(
+        n_storage=args.storage,
+        n_resolvers=args.resolvers,
+        n_tlogs=args.tlogs,
+        replication=args.replication,
+        fsync=args.fsync,
+        commit_pipeline=args.commit_pipeline,
+        resolver_backend=args.resolver_backend,
+        **kw,
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="fdbserver")
+    p.add_argument("--listen", default="127.0.0.1:0",
+                   help="host:port to listen on (port 0 = ephemeral)")
+    p.add_argument("--cluster-file", default=None,
+                   help="cluster file to write this server's address into")
+    p.add_argument("--dir", default=None, help="data directory (WAL, paxos)")
+    p.add_argument("--storage", type=int, default=1)
+    p.add_argument("--resolvers", type=int, default=1)
+    p.add_argument("--tlogs", type=int, default=1)
+    p.add_argument("--replication", type=int, default=None)
+    p.add_argument("--fsync", action="store_true")
+    p.add_argument("--commit-pipeline", default="thread",
+                   choices=["sync", "manual", "thread"],
+                   help="thread = cross-client commit/GRV batching (default)")
+    p.add_argument("--resolver-backend", default="cpu",
+                   choices=["tpu", "cpu", "native"])
+    p.add_argument("--monitor-interval", type=float, default=0.5,
+                   help="failure-detection round interval, seconds")
+    args = p.parse_args(argv)
+
+    host, _, port = args.listen.rpartition(":")
+    cluster = build_cluster(args)
+    server = serve_cluster(cluster, host or "127.0.0.1", int(port))
+    if args.cluster_file:
+        write_cluster_file(args.cluster_file, [server.address])
+
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+
+    # the operator loop the simulation normally pumps: failure detection
+    # + recruitment (ref: ClusterController's failureDetectionServer)
+    print(f"FDBD listening on {server.address}", flush=True)
+    TraceEvent("FdbServerUp").detail(
+        address=server.address, pid=os.getpid()).log()
+    while not stop.wait(args.monitor_interval):
+        try:
+            cluster.detect_and_recruit()
+        except Exception as e:  # keep serving; log the monitor hiccup
+            TraceEvent("FailureMonitorError", severity=30).detail(
+                error=repr(e)).log()
+
+    server.close()
+    cluster.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

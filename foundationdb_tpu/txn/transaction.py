@@ -495,9 +495,15 @@ class _WatchHandle:
         return self._watch is not None and self._watch.fired
 
     def wait(self, timeout=None, poll=0.001):
-        """Block until fired (in-process: commits fire synchronously)."""
+        """Block until fired (in-process: commits fire synchronously;
+        remote: a blocking server-side wait instead of poll RPCs)."""
         if self._watch is None:
             raise err("operation_failed")
+        waiter = getattr(self._watch, "wait_remote", None)
+        if waiter is not None:
+            if waiter(timeout):
+                return True
+            raise err("timed_out")
         start = time.monotonic()
         while not self._watch.fired:
             if timeout is not None and time.monotonic() - start > timeout:
