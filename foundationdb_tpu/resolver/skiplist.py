@@ -81,6 +81,22 @@ class CpuConflictSet:
             self.set_oldest_version(new_window_start)
         return statuses
 
+    def conflicting_ranges(self, txn):
+        """The subset of ``txn``'s read ranges that currently overlap a
+        write newer than its read version — the payload behind the
+        \\xff\\xff/transaction/conflicting_keys/ special keys (ref:
+        conflictingKeysRange population in SkipList.cpp when
+        report_conflicting_keys is set). Called right after the resolve
+        that rejected the txn, so the batch's accepted writes are already
+        in the entry list and intra-batch conflicts report too."""
+        out = []
+        for rb, re_ in txn.read_ranges():
+            for wb, we, wv in self._entries:
+                if wv > txn.read_version and rb < we and wb < re_:
+                    out.append((rb, re_))
+                    break
+        return out
+
     def set_oldest_version(self, version):
         """Advance the MVCC window; prune entries no read can see anymore.
         Monotone: a recovered resolver's fence (window at the recovery

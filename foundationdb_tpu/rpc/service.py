@@ -78,12 +78,23 @@ class ClusterService:
     take their own locks)."""
 
     WATCH_TTL_S = 900  # orphaned watches (client gone) age out
+    MAX_WATCH_WAIT_S = 30.0  # server-side clamp on one blocking chunk
 
     def __init__(self, cluster):
         self.cluster = cluster
         self._watches = {}  # watch_id -> (Watch, threading.Event, born)
         self._watch_ids = itertools.count(1)
         self._watch_lock = threading.Lock()
+        # The plain synchronous CommitProxy (commit_pipeline="sync") has
+        # no internal synchronization — the in-process deployments that
+        # use it are single-threaded. Concurrent RPC clients are not:
+        # serialize their commits here. The "thread" pipeline's batching
+        # proxy takes concurrent submissions natively (that's its job),
+        # so it skips the lock and actually batches across clients.
+        if getattr(cluster, "commit_pipeline", "sync") == "thread":
+            self._commit_lock = None
+        else:
+            self._commit_lock = threading.Lock()
 
     def handlers(self):
         return {
@@ -98,6 +109,13 @@ class ClusterService:
             "watch_register": self.watch_register,
             "watch_poll": self.watch_poll,
             "watch_wait": self.watch_wait,
+            # exclusion returns DD move records (arbitrary role objects);
+            # the wire carries just the relocation count
+            "exclude_storage": lambda sid: len(
+                self.cluster.exclude_storage(sid) or ()
+            ),
+            "include_storage": self.cluster.include_storage,
+            "list_excluded": self.cluster.list_excluded,
         }
 
     def hello(self, client_protocol):
@@ -133,6 +151,9 @@ class ClusterService:
         # the proxy returns (never raises) FDBError verdicts; the wire
         # carries them as values so the client transaction sees the exact
         # in-process contract
+        if self._commit_lock is not None:
+            with self._commit_lock:
+                return self.cluster.commit_proxy.commit(request)
         return self.cluster.commit_proxy.commit(request)
 
     def watch_register(self, key, seen_value):
@@ -183,6 +204,9 @@ class ClusterService:
             entry = self._watches.get(wid)
         if entry is None:
             return True
+        if timeout is None or timeout > self.MAX_WATCH_WAIT_S:
+            timeout = self.MAX_WATCH_WAIT_S  # a client cannot park a
+            # server thread forever; waiters re-issue chunks
         entry[1].wait(timeout=timeout)
         if self._watch_fired(entry):
             with self._watch_lock:
@@ -194,7 +218,9 @@ class ClusterService:
 def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16):
     """Expose a cluster on the network; returns the RpcServer."""
     service = ClusterService(cluster)
-    server = RpcServer(host, port, service.handlers(), max_workers=max_workers)
+    server = RpcServer(host, port, service.handlers(),
+                       max_workers=max_workers,
+                       long_methods={"watch_wait"})
     TraceEvent("RpcServerStarted").detail(address=server.address).log()
     return server
 
@@ -300,6 +326,7 @@ class RemoteCluster:
         self._connect_timeout = connect_timeout
         self._lock = threading.Lock()
         self._client = None
+        self._closed = False
         self._knobs = None
         self.grv_proxy = _RemoteGrvProxy(self)
         self.commit_proxy = _RemoteCommitProxy(self)
@@ -313,6 +340,10 @@ class RemoteCluster:
 
     def _connect(self):
         with self._lock:
+            if self._closed:
+                # a closed handle must stay closed: a racing waiter thread
+                # must not silently resurrect the connection
+                raise ConnectionLost("RemoteCluster is closed")
             if self._client is not None and self._client.alive:
                 return self._client
             if self._client is not None:
@@ -364,6 +395,19 @@ class RemoteCluster:
     def status(self):
         return self._call("status")
 
+    # management surface (the special key space's commit-time handles)
+    def exclude_storage(self, sid):
+        return self._call("exclude_storage", sid)
+
+    def include_storage(self, sid):
+        return self._call("include_storage", sid)
+
+    def list_excluded(self):
+        return self._call("list_excluded")
+
+    def connection_string(self):
+        return ",".join(self.addresses)
+
     def database(self):
         from foundationdb_tpu.txn.database import Database
 
@@ -371,6 +415,7 @@ class RemoteCluster:
 
     def close(self):
         with self._lock:
+            self._closed = True
             if self._client is not None:
                 self._client.close()
                 self._client = None

@@ -63,8 +63,12 @@ class RpcServer:
     remote failure string.
     """
 
-    def __init__(self, host, port, handlers, max_workers=16):
+    def __init__(self, host, port, handlers, max_workers=16,
+                 long_methods=()):
         self.handlers = dict(handlers)
+        # endpoints that legitimately block (watch waits) run on their
+        # own pool so parked waiters cannot starve short RPCs
+        self.long_methods = frozenset(long_methods)
         self._listener = socket.create_server(
             (host, port), reuse_port=False, backlog=64
         )
@@ -72,6 +76,13 @@ class RpcServer:
         self.host, self.port = self._listener.getsockname()[:2]
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="rpc-handler"
+        )
+        self._long_pool = (
+            ThreadPoolExecutor(
+                max_workers=256, thread_name_prefix="rpc-blocking"
+            )
+            if self.long_methods
+            else None
         )
         self._conns = set()
         self._lock = threading.Lock()
@@ -109,7 +120,13 @@ class RpcServer:
                 kind, seq, method, args = wire.loads(frame)
                 if kind != "q":
                     raise ConnectionLost(f"unexpected message kind {kind!r}")
-                self._pool.submit(
+                pool = (
+                    self._long_pool
+                    if self._long_pool is not None
+                    and method in self.long_methods
+                    else self._pool
+                )
+                pool.submit(
                     self._dispatch, sock, send_lock, seq, method, args
                 )
         except (ConnectionLost, ConnectionError, OSError, ValueError):
@@ -166,6 +183,8 @@ class RpcServer:
             except OSError:
                 pass
         self._pool.shutdown(wait=False)
+        if self._long_pool is not None:
+            self._long_pool.shutdown(wait=False)
         self._accept_thread.join(timeout=2)
 
 

@@ -93,6 +93,8 @@ def _enc(buf, v):
     elif isinstance(v, FDBError):
         buf.append(b"e")
         buf.append(struct.pack(">I", v.code))
+        # optional conflicting-keys payload (report_conflicting_keys)
+        _enc(buf, getattr(v, "conflicting_key_ranges", None))
     else:
         raise TypeError(f"wire: cannot encode {type(v).__name__}: {v!r}")
 
@@ -168,7 +170,11 @@ def _dec(r: _Reader):
         return CommitRequest(rv, muts, rcr, wcr, report)
     if tag == b"e":
         (code,) = struct.unpack(">I", r.take(4))
-        return FDBError(code)
+        e = FDBError(code)
+        ranges = _dec(r)
+        if ranges is not None:
+            e.conflicting_key_ranges = ranges
+        return e
     raise ValueError(f"wire: unknown tag {tag!r}")
 
 

@@ -306,6 +306,14 @@ class Cluster:
         """Cancel an exclusion (ref: fdbcli include)."""
         self.dd.excluded.discard(sid)
 
+    def list_excluded(self):
+        return sorted(self.dd.excluded)
+
+    def connection_string(self):
+        """What \\xff\\xff/connection_string reports for an in-process
+        cluster (a remote client reports its cluster-file body)."""
+        return "local"
+
     def storage_drained(self, sid):
         return self.dd.storage_owns_nothing(sid)
 

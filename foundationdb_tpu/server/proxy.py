@@ -140,7 +140,12 @@ class CommitProxy:
                 results.append(FDBError.from_name("transaction_too_old"))
                 batch_conflicts += 1
             else:
-                results.append(FDBError.from_name("not_committed"))
+                e = FDBError.from_name("not_committed")
+                if req.report_conflicting_keys:
+                    e.conflicting_key_ranges = self._conflicting_ranges(
+                        txns[i]
+                    )
+                results.append(e)
                 batch_conflicts += 1
         self.conflict_count += batch_conflicts
         self.commit_count += sum(1 for r in results if not isinstance(r, FDBError))
@@ -189,6 +194,24 @@ class CommitProxy:
             self._batches_since_pump = 0
             self._pump_durability(window)
         return results
+
+    def _conflicting_ranges(self, txn):
+        """Which of a rejected txn's read ranges conflicted (ref: the
+        conflictingKeys reply field of ResolveTransactionBatchReply).
+        Exact for host conflict sets; the TPU backend keeps no
+        per-range verdicts on device, so it reports every read range —
+        conservative, same direction as its false-positive contract."""
+        ranges = []
+        exact = True
+        for r in self.resolvers:
+            cset = getattr(r, "cset", None)
+            if cset is None or not hasattr(cset, "conflicting_ranges"):
+                exact = False
+                break
+            ranges.extend(cset.conflicting_ranges(txn))
+        if exact:
+            return sorted(set(ranges))
+        return sorted(set(txn.read_ranges()))
 
     def _pump_durability(self, window):
         """Periodic updateStorage analog: fold versions that left the MVCC

@@ -1,0 +1,134 @@
+"""The special key space: \\xff\\xff/... module registry.
+
+Ref parity: fdbclient/SpecialKeySpace.actor.cpp — keys above \\xff\\xff
+are not stored rows but views and management handles materialized by the
+client at read time:
+
+- ``\\xff\\xff/status/json``                 → cluster status as JSON bytes
+- ``\\xff\\xff/connection_string``           → how this client reached the
+  cluster (remote: the cluster-file body; in-process: ``local``)
+- ``\\xff\\xff/transaction/conflicting_keys/<begin>`` → after a commit
+  failed 1020 with ``options.set_report_conflicting_keys()``, boundary
+  rows ("1" opens a conflicting range, "0" closes it — the reference's
+  exact encoding)
+- ``\\xff\\xff/management/excluded/<id>``    → storage exclusion: ``set``
+  begins draining the storage at commit, ``clear`` re-includes it, range
+  reads list current exclusions (ref: excludedServersSpecialKeyRange)
+
+Reads of special keys take no read-conflict ranges and never touch
+storage. Management writes are buffered on the transaction and applied
+at commit time, like the reference's special-key commit path.
+"""
+
+import json
+
+from foundationdb_tpu.core.errors import err
+
+PREFIX = b"\xff\xff"
+END = b"\xff\xff\xff"
+
+
+def contains(key):
+    """True iff ``key`` (bytes) lies in the special space [PREFIX, END)."""
+    return isinstance(key, bytes) and key.startswith(PREFIX) and key < END
+
+STATUS_JSON = b"\xff\xff/status/json"
+CONNECTION_STRING = b"\xff\xff/connection_string"
+CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
+EXCLUDED = b"\xff\xff/management/excluded/"
+
+
+def _excluded_rows(tr):
+    cluster = tr._cluster
+    sids = cluster.list_excluded()
+    return [(EXCLUDED + str(s).encode(), b"") for s in sids]
+
+
+def _conflicting_rows(tr):
+    """Boundary encoding: each conflicting range [b, e) contributes
+    (prefix+b, "1") and (prefix+e, "0")."""
+    rows = {}
+    for b, e in getattr(tr, "_conflicting_ranges", []) or []:
+        rows[CONFLICTING_KEYS + b] = b"1"
+        rows.setdefault(CONFLICTING_KEYS + e, b"0")
+    return sorted(rows.items())
+
+
+def get(tr, key):
+    if key == STATUS_JSON:
+        return json.dumps(tr.db.status(), sort_keys=True).encode()
+    if key == CONNECTION_STRING:
+        return tr._cluster.connection_string().encode()
+    if key.startswith(CONFLICTING_KEYS):
+        for k, v in _conflicting_rows(tr):
+            if k == key:
+                return v
+        return None
+    if key.startswith(EXCLUDED):
+        for k, v in _excluded_rows(tr):
+            if k == key:
+                return v
+        return None
+    raise err("key_outside_legal_range")
+
+
+def get_range(tr, begin, end, limit=0, reverse=False):
+    rows = []
+    if begin <= STATUS_JSON < end:
+        rows.append((STATUS_JSON, get(tr, STATUS_JSON)))
+    if begin <= CONNECTION_STRING < end:
+        rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
+    rows += [
+        (k, v) for k, v in _conflicting_rows(tr) if begin <= k < end
+    ]
+    rows += [(k, v) for k, v in _excluded_rows(tr) if begin <= k < end]
+    rows.sort(reverse=reverse)
+    if limit:
+        rows = rows[:limit]
+    return rows
+
+
+def write(tr, key, value):
+    """Buffer a management write; applied by ``commit_special``."""
+    if key.startswith(EXCLUDED):
+        sid = _parse_sid(key)
+        tr._special_writes.append(("exclude", sid))
+        return
+    raise err("key_outside_legal_range")
+
+
+def clear(tr, key):
+    if key.startswith(EXCLUDED):
+        sid = _parse_sid(key)
+        tr._special_writes.append(("include", sid))
+        return
+    raise err("key_outside_legal_range")
+
+
+def clear_range(tr, begin, end):
+    if begin.startswith(EXCLUDED) and end.startswith(EXCLUDED):
+        for k, _ in _excluded_rows(tr):
+            if begin <= k < end:
+                tr._special_writes.append(("include", _parse_sid(k)))
+        return
+    raise err("key_outside_legal_range")
+
+
+def _parse_sid(key):
+    raw = key[len(EXCLUDED):]
+    try:
+        return int(raw.decode())
+    except (UnicodeDecodeError, ValueError):
+        raise err("invalid_option_value") from None
+
+
+def commit_special(tr):
+    """Apply buffered management writes (commit-time semantics, ref:
+    SpecialKeySpace::commit). Idempotent operations; failures surface as
+    the commit's error."""
+    for op, sid in tr._special_writes:
+        if op == "exclude":
+            tr._cluster.exclude_storage(sid)
+        else:
+            tr._cluster.include_storage(sid)
+    tr._special_writes = []

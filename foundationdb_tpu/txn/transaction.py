@@ -19,6 +19,7 @@ from foundationdb_tpu.core.keys import (
 from foundationdb_tpu.core.mutations import Mutation, Op
 from foundationdb_tpu.core.versions import Versionstamp
 from foundationdb_tpu.server.proxy import CommitRequest
+from foundationdb_tpu.txn import specialkeys
 from foundationdb_tpu.txn.rows import WriteMap
 
 _INVALID = object()
@@ -128,6 +129,8 @@ class Transaction:
         self._backoff = self.db._knobs.initial_backoff_s
         self._retries = 0
         self._size = 0
+        self._special_writes = []  # buffered \xff\xff management writes
+        self._conflicting_ranges = None  # from a failed reporting commit
         self._watches_pending = []  # [(key, seen_value, Watch-placeholder)]
         self.options = TransactionOptions(self)
         self.snapshot = _Snapshot(self)
@@ -166,6 +169,8 @@ class Transaction:
     def get(self, key, snapshot=False):
         self._guard()
         key = _check_key(key)
+        if specialkeys.contains(key):
+            return specialkeys.get(self, key)
         rv = self.get_read_version()
         if not self._ryw_disabled:
             known, needs_base, entry = self._writes.lookup(key)
@@ -196,6 +201,17 @@ class Transaction:
         begin/end: bytes or KeySelector. Returns list[(key, value)].
         """
         self._guard()
+        if specialkeys.contains(begin) or (
+            isinstance(begin, KeySelector) and specialkeys.contains(begin.key)
+        ):
+            # special-space ranges take literal bytes only (the reference
+            # rejects selectors against most special-key modules too)
+            if not specialkeys.contains(begin) or not isinstance(end, bytes):
+                raise err("key_outside_legal_range")
+            return specialkeys.get_range(
+                self, begin, min(end, specialkeys.END),
+                limit=limit, reverse=reverse,
+            )
         rv = self.get_read_version()
         st = self._cluster.read_storage()
         if begin is None:
@@ -277,6 +293,9 @@ class Transaction:
     def set(self, key, value):
         self._guard()
         key, value = _check_key(key), _check_value(value)
+        if specialkeys.contains(key):
+            specialkeys.write(self, key, value)
+            return
         self._writes.set(key, value)
         self._log_mutation(Mutation(Op.SET, key, value))
         self._add_write_conflict(key, key_successor(key))
@@ -284,6 +303,9 @@ class Transaction:
     def clear(self, key):
         self._guard()
         key = _check_key(key)
+        if specialkeys.contains(key):
+            specialkeys.clear(self, key)
+            return
         self._writes.clear(key)
         self._log_mutation(Mutation(Op.CLEAR_RANGE, key, key_successor(key)))
         self._add_write_conflict(key, key_successor(key))
@@ -293,6 +315,9 @@ class Transaction:
         begin, end = _check_key(begin), _check_key(end)
         if begin > end:
             raise err("inverted_range")
+        if specialkeys.contains(begin):
+            specialkeys.clear_range(self, begin, end)
+            return
         self._writes.clear_range(begin, end)
         self._log_mutation(Mutation(Op.CLEAR_RANGE, begin, end))
         self._add_write_conflict(begin, end)
@@ -394,7 +419,14 @@ class Transaction:
     def _finish_commit(self, result):
         if isinstance(result, FDBError):
             self._state = "error"
+            # conflict reporting: the failed txn's conflicting read ranges
+            # become readable at \xff\xff/transaction/conflicting_keys/
+            # until the next reset (ref: SpecialKeySpace ConflictingKeys)
+            self._conflicting_ranges = getattr(
+                result, "conflicting_key_ranges", None
+            )
             raise result
+        specialkeys.commit_special(self)
         self._committed_version = result
         self._versionstamp = Versionstamp.from_version(result).tr_version
         self._state = "committed"
@@ -403,7 +435,9 @@ class Transaction:
     def commit(self):
         self._guard()
         if not self._mutation_log and not self._write_conflicts:
-            # read-only: nothing to resolve (ref: read-only commits skip proxies)
+            # read-only (or management-only): nothing to resolve
+            # (ref: read-only commits skip proxies)
+            specialkeys.commit_special(self)
             self._state = "committed"
             self._activate_watches()
             return

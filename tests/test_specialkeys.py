@@ -1,0 +1,120 @@
+"""Special key space (\\xff\\xff/...) — status/json, connection_string,
+conflicting_keys after a reporting commit failure, and management
+exclusion handles, in-process and over the RPC transport."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.txn import specialkeys
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def db():
+    cluster = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    yield cluster.database()
+    cluster.close()
+
+
+def test_status_json_key(db):
+    raw = db.run(lambda tr: tr.get(specialkeys.STATUS_JSON))
+    st = json.loads(raw)
+    assert st["cluster"]["database_available"]
+
+
+def test_connection_string_key(db):
+    assert db.run(lambda tr: tr.get(specialkeys.CONNECTION_STRING)) == b"local"
+
+
+def test_special_reads_add_no_conflict_ranges(db):
+    tr = db.create_transaction()
+    tr.get(specialkeys.STATUS_JSON)
+    tr.get_range(b"\xff\xff/management/", b"\xff\xff/management0")
+    assert tr._read_conflicts == []
+    tr[b"k"] = b"v"
+    tr.commit()
+
+
+def test_unknown_special_key_rejected(db):
+    tr = db.create_transaction()
+    with pytest.raises(FDBError) as ei:
+        tr.get(b"\xff\xff/nope")
+    assert ei.value.code == 2004  # key_outside_legal_range
+    with pytest.raises(FDBError):
+        tr.set(b"\xff\xff/nope", b"x")
+
+
+def test_conflicting_keys_after_reported_conflict(db):
+    db[b"a"] = b"1"
+    db[b"b"] = b"2"
+    tr = db.create_transaction()
+    tr.options.set_report_conflicting_keys()
+    _ = tr[b"a"]
+    _ = tr[b"b"]
+    # competing commit on 'a' lands first
+    db[b"a"] = b"other"
+    tr[b"c"] = b"3"
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1020
+    rows = tr.get_range(specialkeys.CONFLICTING_KEYS,
+                        specialkeys.CONFLICTING_KEYS + b"\xff")
+    # boundary encoding: 'a' opens a conflicting range, its successor
+    # closes it; the clean read of 'b' must NOT be reported
+    assert (specialkeys.CONFLICTING_KEYS + b"a", b"1") in rows
+    opened = [k for k, v in rows if v == b"1"]
+    assert not any(k.endswith(b"/b") for k in opened)
+
+
+def test_exclusion_via_management_keys():
+    cluster = Cluster(n_storage=3, replication=2, resolver_backend="cpu",
+                      **TEST_KNOBS)
+    db = cluster.database()
+    try:
+        for i in range(20):
+            db[b"k%02d" % i] = b"v" * 50
+        db.run(lambda tr: tr.set(specialkeys.EXCLUDED + b"2", b""))
+        assert cluster.list_excluded() == [2]
+        rows = db.run(lambda tr: tr.get_range(
+            specialkeys.EXCLUDED, specialkeys.EXCLUDED + b"\xff"))
+        assert rows == [(specialkeys.EXCLUDED + b"2", b"")]
+        # re-include by clearing the key
+        db.run(lambda tr: tr.clear(specialkeys.EXCLUDED + b"2"))
+        assert cluster.list_excluded() == []
+    finally:
+        cluster.close()
+
+
+def test_special_keys_over_rpc():
+    cluster = Cluster(n_storage=2, resolver_backend="cpu", **TEST_KNOBS)
+    server = serve_cluster(cluster)
+    rc = RemoteCluster([server.address])
+    db = rc.database()
+    try:
+        st = json.loads(db.run(lambda tr: tr.get(specialkeys.STATUS_JSON)))
+        assert st["cluster"]["database_available"]
+        conn = db.run(lambda tr: tr.get(specialkeys.CONNECTION_STRING))
+        assert conn.decode() == server.address
+        db.run(lambda tr: tr.set(specialkeys.EXCLUDED + b"1", b""))
+        assert cluster.list_excluded() == [1]
+        # conflict reporting round-trips the wire
+        db[b"x"] = b"0"
+        tr = db.create_transaction()
+        tr.options.set_report_conflicting_keys()
+        _ = tr[b"x"]
+        cluster.database()[b"x"] = b"racer"
+        tr[b"y"] = b"1"
+        with pytest.raises(FDBError):
+            tr.commit()
+        rows = tr.get_range(specialkeys.CONFLICTING_KEYS,
+                            specialkeys.CONFLICTING_KEYS + b"\xff")
+        assert (specialkeys.CONFLICTING_KEYS + b"x", b"1") in rows
+    finally:
+        rc.close()
+        server.close()
+        cluster.close()
