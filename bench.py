@@ -403,6 +403,15 @@ def main():
     # actually runs
     lag = int(env("BENCH_LAG", 4 if not cpu else 1))
 
+    # range mode on TPU: the ring lanes run the Pallas VMEM kernel
+    # (ops/pallas_ring.py). Point mode has no ring (range_writes=0), and
+    # CPU runs would pay the interpreter. If the Mosaic compile fails on
+    # this chip, fall back to the jnp lanes rather than shipping no
+    # number.
+    pallas_note = None
+    if not cpu and not point and env("BENCH_PALLAS", "1") != "0":
+        params = params._replace(use_pallas=True)
+
     build = build_batches if point else build_range_batches
     batches = build(params, nbatches, nkeys, theta=0.99)
     megas = stack_batches(batches, group)
@@ -410,8 +419,19 @@ def main():
     state = ck.init_state(params)
 
     # warmup / compile
-    state, st = step(state, megas[0])
-    np.asarray(st)
+    try:
+        state, st = step(state, megas[0])
+        np.asarray(st)
+    except Exception as e:
+        if not params.use_pallas:
+            raise
+        pallas_note = f"{type(e).__name__}: {e}"[:200]
+        sys.stderr.write(f"pallas ring kernel failed, jnp lanes: {e}\n")
+        params = params._replace(use_pallas=False)
+        step = ck.make_resolve_scan_fn(params, donate=True)
+        state = ck.init_state(params)
+        state, st = step(state, megas[0])
+        np.asarray(st)
     state = ck.init_state(params)
 
     kernel_ms = measure_kernel_step_ms(ck, params, batches[0])
@@ -473,6 +493,7 @@ def main():
         "commit_rate": round(committed / max(total, 1), 4),
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
+        "pallas_ring": bool(params.use_pallas),
         # workload scale, so CPU-scaled fallback runs are self-describing
         "nkeys": nkeys,
         "nbatches": nbatches,
@@ -480,6 +501,8 @@ def main():
     }
     if fallback_note is not None:
         out["fallback_from"] = fallback_note[:200]
+    if pallas_note is not None:
+        out["pallas_fallback"] = pallas_note
     # end-to-end pipeline number alongside the kernel-only number (point
     # mode only; BENCH_E2E=0 skips)
     if point and env("BENCH_E2E", "1") != "0":

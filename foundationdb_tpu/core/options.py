@@ -22,6 +22,10 @@ class Knobs:
     range_ring_capacity: int = 4096  # recent range-write ring (exact lane)
     coarse_buckets_bits: int = 14  # 2^bits contiguous key buckets (coarse lane)
     key_limbs: int = 8  # 4*L bytes of exact key prefix on device
+    # ring lanes via the Pallas VMEM kernel (ops/pallas_ring.py):
+    # "auto" = on TPU backends, "on" = everywhere (interpreter off-TPU,
+    # for differential tests), "off" = always the jnp lanes
+    pallas_ring: str = "auto"
 
     # --- versions / MVCC ---
     versions_per_second: int = 1_000_000

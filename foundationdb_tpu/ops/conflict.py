@@ -76,6 +76,7 @@ class ResolverParams(NamedTuple):
     hash_bits: int = 22  # point table size 2^HB
     ring_capacity: int = 4096  # KR
     bucket_bits: int = 14  # C = 2^bucket_bits coarse buckets
+    use_pallas: bool = False  # ring lanes via the Pallas VMEM kernel
 
 
 class ResolverState(NamedTuple):
@@ -257,6 +258,16 @@ def resolve_batch(
         pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
         suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
 
+    # the Pallas ring kernel runs the single-shard path only (each
+    # shard_map lane is its own program; the jnp lanes stay canonical
+    # there); interpret mode keeps it runnable (and differential-
+    # testable) on CPU
+    pallas_ring_on = params.use_pallas and axis_name is None
+    if pallas_ring_on:
+        from foundationdb_tpu.ops.pallas_ring import ring_hits
+
+        interp = jax.default_backend() != "tpu"
+
     # point reads vs point-write hash table (exact lane)
     if params.point_reads:
         own_pr = hash_owned(batch.pr_hash)
@@ -264,11 +275,24 @@ def resolve_batch(
         hit = (ht_v > rv[:, None]) & batch.pr_mask & own_pr
         if params.range_writes:
             # point reads vs recent range-writes (exact ring)
-            in_rng = _point_in(
-                batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
-            )  # [T, PR, KR]
-            newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
-            hit |= jnp.any(in_rng & newer, axis=2) & batch.pr_mask
+            # lane counts come from the arrays: packers may statically
+            # zero-width lanes a workload never uses
+            PR = batch.pr_key.shape[1]
+            if pallas_ring_on and PR:
+                flat_k = batch.pr_key.reshape(T * PR, params.key_width)
+                rv_q = jnp.broadcast_to(rv[:, None], (T, PR)).reshape(-1)
+                ring_hit = ring_hits(
+                    flat_k, flat_k, rv_q, state.ring_b, state.ring_e,
+                    state.ring_v, state.ring_mask,
+                    point_mode=True, interpret=interp,
+                ).reshape(T, PR)
+            else:
+                in_rng = _point_in(
+                    batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
+                )  # [T, PR, KR]
+                newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+                ring_hit = jnp.any(in_rng & newer, axis=2)
+            hit |= ring_hit & batch.pr_mask
             # point reads vs evicted range-writes (coarse interval summary)
             coarse = jnp.minimum(pref_L[batch.pr_bucket], suf_R[batch.pr_bucket])
             hit |= (coarse > rv[:, None]) & batch.pr_mask
@@ -278,14 +302,26 @@ def resolve_batch(
     if params.range_reads:
         hit = jnp.zeros((T, params.range_reads), bool)
         if params.range_writes:
-            ov = ranges_overlap(
-                batch.rr_b[:, :, None, :],
-                batch.rr_e[:, :, None, :],
-                state.ring_b[None, None],
-                state.ring_e[None, None],
-            )  # [T, RR, KR]
-            newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
-            hit |= jnp.any(ov & newer, axis=2) & batch.rr_mask
+            RR = batch.rr_b.shape[1]
+            if pallas_ring_on and RR:
+                rv_q = jnp.broadcast_to(rv[:, None], (T, RR)).reshape(-1)
+                ring_hit = ring_hits(
+                    batch.rr_b.reshape(T * RR, params.key_width),
+                    batch.rr_e.reshape(T * RR, params.key_width),
+                    rv_q, state.ring_b, state.ring_e,
+                    state.ring_v, state.ring_mask,
+                    point_mode=False, interpret=interp,
+                ).reshape(T, RR)
+            else:
+                ov = ranges_overlap(
+                    batch.rr_b[:, :, None, :],
+                    batch.rr_e[:, :, None, :],
+                    state.ring_b[None, None],
+                    state.ring_e[None, None],
+                )  # [T, RR, KR]
+                newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
+                ring_hit = jnp.any(ov & newer, axis=2)
+            hit |= ring_hit & batch.rr_mask
             coarse_rng = jnp.minimum(pref_L[batch.rr_hi], suf_R[batch.rr_lo])
             hit |= (coarse_rng > rv[:, None]) & batch.rr_mask
         if params.point_writes:

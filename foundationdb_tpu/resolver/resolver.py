@@ -34,6 +34,10 @@ class Resolver:
         self.base_version = base_version
         self.alive = True
         if self.backend == "tpu":
+            pallas = getattr(knobs, "pallas_ring", "auto")
+            use_pallas = pallas == "on" or (
+                pallas == "auto" and jax.default_backend() == "tpu"
+            )
             self.params = ck.ResolverParams(
                 txns=knobs.batch_txn_capacity,
                 point_reads=knobs.point_reads_per_txn,
@@ -44,6 +48,7 @@ class Resolver:
                 hash_bits=knobs.hash_table_bits,
                 ring_capacity=knobs.range_ring_capacity,
                 bucket_bits=knobs.coarse_buckets_bits,
+                use_pallas=use_pallas,
             )
             self.packer = BatchPacker(self.params)
             self.state = ck.init_state(self.params)

@@ -39,19 +39,35 @@ EXCLUDED = b"\xff\xff/management/excluded/"
 
 
 def _excluded_rows(tr):
-    cluster = tr._cluster
-    sids = cluster.list_excluded()
-    return [(EXCLUDED + str(s).encode(), b"") for s in sids]
+    """Current exclusions overlaid with this txn's pending management
+    writes (read-your-writes, like the reference SpecialKeySpace merging
+    uncommitted special-space writes into reads)."""
+    sids = set(tr._cluster.list_excluded())
+    for op, sid in tr._special_writes:
+        if op == "exclude":
+            sids.add(sid)
+        else:
+            sids.discard(sid)
+    return [(EXCLUDED + str(s).encode(), b"") for s in sorted(sids)]
 
 
 def _conflicting_rows(tr):
     """Boundary encoding: each conflicting range [b, e) contributes
-    (prefix+b, "1") and (prefix+e, "0")."""
-    rows = {}
-    for b, e in getattr(tr, "_conflicting_ranges", []) or []:
-        rows[CONFLICTING_KEYS + b] = b"1"
-        rows.setdefault(CONFLICTING_KEYS + e, b"0")
-    return sorted(rows.items())
+    (prefix+b, "1") and (prefix+e, "0"). Overlapping/adjacent ranges are
+    merged first so an interior end key cannot close a region another
+    range still covers."""
+    ranges = sorted(getattr(tr, "_conflicting_ranges", []) or [])
+    merged = []
+    for b, e in ranges:
+        if merged and b <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([b, e])
+    rows = []
+    for b, e in merged:
+        rows.append((CONFLICTING_KEYS + b, b"1"))
+        rows.append((CONFLICTING_KEYS + e, b"0"))
+    return rows
 
 
 def get(tr, key):

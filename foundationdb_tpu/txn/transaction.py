@@ -188,6 +188,10 @@ class Transaction:
 
     def get_key(self, selector, snapshot=False):
         self._guard()
+        if specialkeys.contains(getattr(selector, "key", None)):
+            # selector resolution is not defined over the virtual special
+            # space (module rows are materialized, not stored)
+            raise err("key_outside_legal_range")
         rv = self.get_read_version()
         k = self._cluster.read_storage().resolve_selector(selector, rv)
         if not snapshot and k not in (b"", b"\xff"):
@@ -329,6 +333,10 @@ class Transaction:
     def _atomic(self, op, key, param):
         self._guard()
         key = _check_key(key)
+        if specialkeys.contains(key):
+            # management modules take set/clear only; an atomic would
+            # smuggle a raw mutation into the virtual keyspace
+            raise err("key_outside_legal_range")
         param = bytes(param)
         self._writes.atomic(op, key, param)
         self._log_mutation(Mutation(op, key, param))

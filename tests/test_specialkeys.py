@@ -118,3 +118,40 @@ def test_special_keys_over_rpc():
         rc.close()
         server.close()
         cluster.close()
+
+
+def test_conflicting_keys_overlapping_ranges_merge(db):
+    """Overlapping conflicting read ranges must merge before boundary
+    encoding — an interior end key must not close a still-covered region."""
+    tr = db.create_transaction()
+    tr._conflicting_ranges = [(b"a", b"c"), (b"b", b"d")]
+    rows = tr.get_range(specialkeys.CONFLICTING_KEYS,
+                        specialkeys.CONFLICTING_KEYS + b"\xff")
+    assert rows == [
+        (specialkeys.CONFLICTING_KEYS + b"a", b"1"),
+        (specialkeys.CONFLICTING_KEYS + b"d", b"0"),
+    ]
+
+
+def test_management_writes_are_ryw(db):
+    tr = db.create_transaction()
+    tr.set(specialkeys.EXCLUDED + b"0", b"")
+    rows = tr.get_range(specialkeys.EXCLUDED, specialkeys.EXCLUDED + b"\xff")
+    assert rows == [(specialkeys.EXCLUDED + b"0", b"")]
+    tr.clear(specialkeys.EXCLUDED + b"0")
+    assert tr.get_range(specialkeys.EXCLUDED,
+                        specialkeys.EXCLUDED + b"\xff") == []
+    tr.commit()
+    assert db._cluster.list_excluded() == []
+
+
+def test_atomics_and_selectors_rejected_in_special_space(db):
+    from foundationdb_tpu.core.keys import KeySelector
+
+    tr = db.create_transaction()
+    with pytest.raises(FDBError) as ei:
+        tr.add(specialkeys.EXCLUDED + b"1", (1).to_bytes(8, "little"))
+    assert ei.value.code == 2004
+    with pytest.raises(FDBError) as ei:
+        tr.get_key(KeySelector(specialkeys.STATUS_JSON, True, 0))
+    assert ei.value.code == 2004
