@@ -105,11 +105,14 @@ def ring_hits(qlo, qhi, rv, ring_b, ring_e, ring_v, ring_mask,
 
     qlo_t = _pad_axis(_signed(qlo).T, LANES, 1)  # [W, Qp]
     qhi_t = _pad_axis(_signed(qhi).T, LANES, 1)
-    rv_p = _pad_axis(rv.astype(jnp.int32).reshape(1, Q), LANES, 1)
+    # versions get the same order-preserving sign-flip as the key limbs:
+    # the jnp lanes compare uint32, and offsets may legally reach 2^31
+    # before a rebase (the host threshold is policy, not a contract here)
+    rv_p = _pad_axis(_signed(rv).reshape(1, Q), LANES, 1)
     tk = min(ring_tile, ((KR + LANES - 1) // LANES) * LANES)
     rb_t = _pad_axis(_signed(ring_b).T, tk, 1)  # [W, KRp]
     re_t = _pad_axis(_signed(ring_e).T, tk, 1)
-    rver = _pad_axis(ring_v.astype(jnp.int32).reshape(1, KR), tk, 1)
+    rver = _pad_axis(_signed(ring_v).reshape(1, KR), tk, 1)
     rmask = _pad_axis(ring_mask.astype(jnp.int32).reshape(1, KR), tk, 1)
 
     qp, krp = qlo_t.shape[1], rb_t.shape[1]

@@ -95,7 +95,21 @@ class Resolver:
             batch = self.packer.pack(
                 [t for _, t in chunk], self.base_version, commit_version, new_window_start
             )
-            status, _accepted, self.state = self._resolve(self.state, batch)
+            try:
+                status, _accepted, self.state = self._resolve(self.state, batch)
+            except Exception:
+                if not self.params.use_pallas:
+                    raise
+                # the Pallas ring kernel failed to build/run on this
+                # backend: fall back to the jnp lanes for the life of the
+                # resolver rather than failing every commit (bench.py
+                # does the same in its harness; this is the serving path)
+                from foundationdb_tpu.utils.trace import TraceEvent
+
+                TraceEvent("PallasRingFallback", severity=30).log()
+                self.params = self.params._replace(use_pallas=False)
+                self._resolve = ck.make_resolve_fn(self.params)
+                status, _accepted, self.state = self._resolve(self.state, batch)
             out = np.asarray(status)[: len(chunk)].tolist()
             for (i, _), s in zip(chunk, out):
                 statuses[i] = s

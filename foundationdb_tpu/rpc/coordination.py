@@ -1,0 +1,104 @@
+"""Coordinators over the network: the disk-Paxos quorum as real
+processes.
+
+Ref parity: fdbserver/Coordination.actor.cpp — coordinators are
+standalone processes named in the cluster file; the recovering master
+reaches them over the transport to read and lock the coordinated
+state. `CoordinatorService` exposes one disk-backed Coordinator replica
+as RPC endpoints; `RemoteCoordinator` is the proposer-side stub whose
+connection failures ARE the unreachable-coordinator signal
+(CoordinatorDown), so `CoordinationQuorum` runs unchanged over any mix
+of local and remote replicas — majorities tolerate minority process
+death exactly as in-process quorums tolerate killed replicas.
+
+Ballot striding across independent proposer processes uses a random
+64-bit proposer id with a 2^64 stride: ballots never collide without
+needing the proposers to know each other.
+"""
+
+import random
+
+from foundationdb_tpu.rpc.transport import ConnectionLost, RpcClient
+from foundationdb_tpu.server.coordination import (
+    Coordinator,
+    CoordinationQuorum,
+    CoordinatorDown,
+)
+
+BALLOT_STRIDE = 1 << 64
+
+
+class CoordinatorService:
+    """RPC endpoint table over one Coordinator replica (runs inside an
+    fdbserver-style process; see tools/fdbserver.py)."""
+
+    def __init__(self, path=None):
+        self.replica = Coordinator(path)
+
+    def handlers(self):
+        return {
+            "coord_prepare": self.replica.prepare,
+            "coord_accept": self.replica.accept,
+            "coord_read": self.replica.read,
+        }
+
+
+class RemoteCoordinator:
+    """Proposer-side stub for one coordinator process.
+
+    Lazily (re)connects per call; any transport failure surfaces as
+    CoordinatorDown, which the quorum treats as that replica being
+    unreachable — a minority of dead processes is tolerated."""
+
+    def __init__(self, address, connect_timeout=3.0, call_timeout=10.0):
+        self.address = address
+        self._connect_timeout = connect_timeout
+        self._call_timeout = call_timeout
+        self._client = None
+        self.alive = True  # parity with the in-process replica surface
+
+    def _call(self, method, *args):
+        try:
+            if self._client is None or not self._client.alive:
+                host, _, port = self.address.rpartition(":")
+                self._client = RpcClient(
+                    host, int(port), self._connect_timeout
+                )
+            return self._client.call(
+                method, *args, timeout=self._call_timeout
+            )
+        except (ConnectionLost, OSError, TimeoutError) as e:
+            raise CoordinatorDown(
+                f"coordinator {self.address} unreachable: {e}"
+            ) from e
+
+    def prepare(self, ballot):
+        ok, promised, accepted, accepted_ballot = self._call(
+            "coord_prepare", ballot
+        )
+        return ok, promised, accepted, accepted_ballot
+
+    def accept(self, ballot, value):
+        return self._call("coord_accept", ballot, value)
+
+    def read(self):
+        ballot, value = self._call("coord_read")
+        return ballot, value
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+def remote_quorum(addresses, proposer_id=None):
+    """A CoordinationQuorum over coordinator processes at ``addresses``
+    (each a ``host:port`` whose RpcServer registers CoordinatorService
+    handlers). Proposer ids are drawn at random from a 64-bit space so
+    independent recovering processes stride disjoint ballot sequences."""
+    if proposer_id is None:
+        proposer_id = random.getrandbits(64)
+    coords = [RemoteCoordinator(a) for a in addresses]
+    return CoordinationQuorum(
+        coords, proposer_id=proposer_id, n_proposers=BALLOT_STRIDE
+    )

@@ -68,7 +68,7 @@ class RpcServer:
         self.handlers = dict(handlers)
         # endpoints that legitimately block (watch waits) run on their
         # own pool so parked waiters cannot starve short RPCs
-        self.long_methods = frozenset(long_methods)
+        self.long_methods = set(long_methods)
         self._listener = socket.create_server(
             (host, port), reuse_port=False, backlog=64
         )
@@ -95,6 +95,23 @@ class RpcServer:
     @property
     def address(self):
         return f"{self.host}:{self.port}"
+
+    def add_handlers(self, handlers, long_methods=()):
+        """Register more endpoints on a live server (an fdbserver process
+        brings its coordinator endpoints up first so peers can reach the
+        quorum, then attaches the cluster service after recovery).
+
+        Long-method routing is installed BEFORE the handlers become
+        callable: a blocking endpoint must never be reachable while it
+        would still dispatch onto the short-RPC pool."""
+        new_long = set(long_methods) - self.long_methods
+        if new_long:
+            if self._long_pool is None:
+                self._long_pool = ThreadPoolExecutor(
+                    max_workers=256, thread_name_prefix="rpc-blocking"
+                )
+            self.long_methods |= new_long
+        self.handlers.update(handlers)
 
     def _accept_loop(self):
         while not self._closed.is_set():

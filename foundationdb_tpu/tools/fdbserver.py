@@ -1,16 +1,25 @@
-"""fdbserver-shaped process entry: host a cluster behind the RPC
-transport.
+"""fdbserver-shaped process entry: host a cluster (and a coordinator
+replica) behind the RPC transport.
 
 Ref parity: fdbserver/fdbserver.actor.cpp's worker process — started
 with a listen address and a data directory, it serves the database to
-any client holding the cluster file. Role topology (storage count,
-resolvers, tlog replicas, replication factor) is configured by flags the
-way the reference's is configured through the cluster.
+any client holding the cluster file. Every process also hosts a
+coordinator replica (ref: coordinators are fdbserver processes named in
+the cluster file); ``--coordinators`` points recovery at a quorum of
+peer processes, and ``--coordinator-only`` runs just the replica, so a
+deployment looks like the reference's: N coordinator processes + a
+transaction-system process, with recovery locking the generation
+through a real network majority.
 
 Usage::
 
+    # three coordinators
+    python -m foundationdb_tpu.tools.fdbserver --listen 127.0.0.1:4510 \
+        --coordinator-only --dir /var/co1   (and 4511, 4512...)
+    # the database server, recovering through that quorum
     python -m foundationdb_tpu.tools.fdbserver \
-        --listen 127.0.0.1:4500 --dir /var/db --cluster-file fdb.cluster
+        --listen 127.0.0.1:4500 --dir /var/db --cluster-file fdb.cluster \
+        --coordinators 127.0.0.1:4510,127.0.0.1:4511,127.0.0.1:4512
 
 The cluster file is (re)written with this server's address on startup,
 so `foundationdb_tpu.open(cluster_file=...)` finds it.
@@ -22,17 +31,24 @@ import signal
 import sys
 import threading
 
-from foundationdb_tpu.rpc.service import serve_cluster, write_cluster_file
-from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.rpc.coordination import CoordinatorService, remote_quorum
+from foundationdb_tpu.rpc.service import (
+    ClusterService,
+    write_cluster_file,
+)
+from foundationdb_tpu.rpc.transport import RpcServer
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
-def build_cluster(args):
+def build_cluster(args, coordination=None):
+    from foundationdb_tpu.server.cluster import Cluster
+
     kw = {}
     if args.dir:
         os.makedirs(args.dir, exist_ok=True)
         kw["wal_path"] = os.path.join(args.dir, "tlog.wal")
-        kw["coordination_dir"] = os.path.join(args.dir, "coordination")
+        if coordination is None:
+            kw["coordination_dir"] = os.path.join(args.dir, "coordination")
     return Cluster(
         n_storage=args.storage,
         n_resolvers=args.resolvers,
@@ -41,6 +57,7 @@ def build_cluster(args):
         fsync=args.fsync,
         commit_pipeline=args.commit_pipeline,
         resolver_backend=args.resolver_backend,
+        coordination=coordination,
         **kw,
     )
 
@@ -52,6 +69,11 @@ def main(argv=None):
     p.add_argument("--cluster-file", default=None,
                    help="cluster file to write this server's address into")
     p.add_argument("--dir", default=None, help="data directory (WAL, paxos)")
+    p.add_argument("--coordinators", default=None,
+                   help="comma-separated coordinator addresses; recovery "
+                        "locks its generation through this quorum")
+    p.add_argument("--coordinator-only", action="store_true",
+                   help="host only the coordinator replica (no database)")
     p.add_argument("--storage", type=int, default=1)
     p.add_argument("--resolvers", type=int, default=1)
     p.add_argument("--tlogs", type=int, default=1)
@@ -67,10 +89,28 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     host, _, port = args.listen.rpartition(":")
-    cluster = build_cluster(args)
-    server = serve_cluster(cluster, host or "127.0.0.1", int(port))
-    if args.cluster_file:
-        write_cluster_file(args.cluster_file, [server.address])
+
+    # coordinator endpoints come up FIRST: peer recoveries must be able
+    # to reach this replica before (and regardless of) any local cluster
+    coord_path = None
+    if args.dir:
+        os.makedirs(args.dir, exist_ok=True)
+        coord_path = os.path.join(args.dir, "coordinator.json")
+    coord = CoordinatorService(coord_path)
+    server = RpcServer(host or "127.0.0.1", int(port), coord.handlers())
+
+    cluster = None
+    if not args.coordinator_only:
+        coordination = None
+        if args.coordinators:
+            coordination = remote_quorum(
+                [a.strip() for a in args.coordinators.split(",")]
+            )
+        cluster = build_cluster(args, coordination)
+        service = ClusterService(cluster)
+        server.add_handlers(service.handlers(), long_methods={"watch_wait"})
+        if args.cluster_file:
+            write_cluster_file(args.cluster_file, [server.address])
 
     stop = threading.Event()
 
@@ -80,12 +120,15 @@ def main(argv=None):
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
 
+    role = "coordinator" if args.coordinator_only else "fdbserver"
+    print(f"FDBD listening on {server.address} ({role})", flush=True)
+    TraceEvent("FdbServerUp").detail(
+        address=server.address, role=role, pid=os.getpid()).log()
     # the operator loop the simulation normally pumps: failure detection
     # + recruitment (ref: ClusterController's failureDetectionServer)
-    print(f"FDBD listening on {server.address}", flush=True)
-    TraceEvent("FdbServerUp").detail(
-        address=server.address, pid=os.getpid()).log()
     while not stop.wait(args.monitor_interval):
+        if cluster is None:
+            continue
         try:
             cluster.detect_and_recruit()
         except Exception as e:  # keep serving; log the monitor hiccup
@@ -93,7 +136,8 @@ def main(argv=None):
                 error=repr(e)).log()
 
     server.close()
-    cluster.close()
+    if cluster is not None:
+        cluster.close()
     return 0
 
 
