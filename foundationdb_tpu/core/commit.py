@@ -1,0 +1,21 @@
+"""CommitRequest — what a client sends at commit.
+
+Ref parity: CommitTransactionRequest (fdbclient/CommitTransaction.h).
+Lives in core (not server/proxy.py, which re-exports it) so that
+dependency-light consumers — the wire codec, coordinator-only server
+processes — can name the type without pulling the resolver stack (and
+with it JAX) into their import graph.
+"""
+
+
+class CommitRequest:
+    __slots__ = ("read_version", "mutations", "read_conflict_ranges",
+                 "write_conflict_ranges", "report_conflicting_keys")
+
+    def __init__(self, read_version, mutations, read_conflict_ranges,
+                 write_conflict_ranges, report_conflicting_keys=False):
+        self.read_version = read_version
+        self.mutations = mutations
+        self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
+        self.write_conflict_ranges = write_conflict_ranges
+        self.report_conflicting_keys = report_conflicting_keys

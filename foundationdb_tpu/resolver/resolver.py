@@ -97,20 +97,33 @@ class Resolver:
             )
             try:
                 status, _accepted, self.state = self._resolve(self.state, batch)
+                # materialize INSIDE the try: dispatch is async, so a
+                # kernel that compiles but faults at runtime only raises
+                # here — outside, the fallback would never engage and
+                # self.state would hold poisoned arrays
+                out = np.asarray(status)[: len(chunk)].tolist()
             except Exception:
                 if not self.params.use_pallas:
                     raise
-                # the Pallas ring kernel failed to build/run on this
+                # The Pallas ring kernel failed to build/run on this
                 # backend: fall back to the jnp lanes for the life of the
-                # resolver rather than failing every commit (bench.py
-                # does the same in its harness; this is the serving path)
+                # resolver rather than failing every commit. The device
+                # history may be donated/poisoned by the failed dispatch,
+                # so restart fenced exactly like a recruited resolver —
+                # this batch (and any read version from before the fence)
+                # retries TOO_OLD with fresh reads.
                 from foundationdb_tpu.utils.trace import TraceEvent
 
-                TraceEvent("PallasRingFallback", severity=30).log()
+                TraceEvent("PallasRingFallback", severity=30).detail(
+                    fenced_at=commit_version).log()
                 self.params = self.params._replace(use_pallas=False)
                 self._resolve = ck.make_resolve_fn(self.params)
-                status, _accepted, self.state = self._resolve(self.state, batch)
-            out = np.asarray(status)[: len(chunk)].tolist()
+                self.state = ck.init_state(self.params)
+                self.base_version = commit_version
+                for j in range(len(statuses)):
+                    if statuses[j] is None:
+                        statuses[j] = TOO_OLD
+                return statuses
             for (i, _), s in zip(chunk, out):
                 statuses[i] = s
         return statuses

@@ -18,7 +18,11 @@ needing the proposers to know each other.
 
 import random
 
-from foundationdb_tpu.rpc.transport import ConnectionLost, RpcClient
+from foundationdb_tpu.rpc.transport import (
+    ConnectionLost,
+    RemoteError,
+    RpcClient,
+)
 from foundationdb_tpu.server.coordination import (
     Coordinator,
     CoordinationQuorum,
@@ -67,7 +71,10 @@ class RemoteCoordinator:
             return self._client.call(
                 method, *args, timeout=self._call_timeout
             )
-        except (ConnectionLost, OSError, TimeoutError) as e:
+        except (ConnectionLost, OSError, TimeoutError, RemoteError) as e:
+            # RemoteError too: a replica whose handler faults server-side
+            # (full disk mid-fsync) is as unavailable as a dead one — the
+            # quorum must ride over it, not crash the recovering master
             raise CoordinatorDown(
                 f"coordinator {self.address} unreachable: {e}"
             ) from e

@@ -116,6 +116,7 @@ class ClusterService:
             ),
             "include_storage": self.cluster.include_storage,
             "list_excluded": self.cluster.list_excluded,
+            "consistency_check": self.cluster.consistency_check,
         }
 
     def hello(self, client_protocol):
@@ -404,6 +405,9 @@ class RemoteCluster:
 
     def list_excluded(self):
         return self._call("list_excluded")
+
+    def consistency_check(self, max_keys_per_shard=None):
+        return self._call("consistency_check", max_keys_per_shard)
 
     def connection_string(self):
         return ",".join(self.addresses)

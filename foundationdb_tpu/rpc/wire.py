@@ -13,10 +13,10 @@ bigint escape so versionstamp-scale values never truncate silently.
 
 import struct
 
+from foundationdb_tpu.core.commit import CommitRequest
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.core.mutations import Mutation, Op
-from foundationdb_tpu.server.proxy import CommitRequest
 
 PROTOCOL_VERSION = 1
 

@@ -317,6 +317,13 @@ class Cluster:
     def storage_drained(self, sid):
         return self.dd.storage_owns_nothing(sid)
 
+    def consistency_check(self, max_keys_per_shard=None):
+        """Replica agreement audit (ref: the ConsistencyCheck workload /
+        fdbcli consistencycheck). Returns error strings; [] = clean."""
+        from foundationdb_tpu.server.consistency import consistency_check
+
+        return consistency_check(self, max_keys_per_shard)
+
     def persist_shard_map(self):
         """Write the live shard map to \\xff/keyServers/ through the
         normal commit pipeline — tlog-durable, recovered like user data

@@ -8,27 +8,13 @@ to keep batches full (the opposite pressure from the reference, whose
 resolver cost grows with batch size).
 """
 
+from foundationdb_tpu.core.commit import CommitRequest  # noqa: F401  (re-export)
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
 from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.tlog import TLogDown
-
-
-class CommitRequest:
-    """What a client sends at commit (ref: CommitTransactionRequest)."""
-
-    __slots__ = ("read_version", "mutations", "read_conflict_ranges",
-                 "write_conflict_ranges", "report_conflicting_keys")
-
-    def __init__(self, read_version, mutations, read_conflict_ranges,
-                 write_conflict_ranges, report_conflicting_keys=False):
-        self.read_version = read_version
-        self.mutations = mutations
-        self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
-        self.write_conflict_ranges = write_conflict_ranges
-        self.report_conflicting_keys = report_conflicting_keys
 
 
 class CommitProxy:

@@ -265,6 +265,17 @@ class Cli:
         cluster.include_storage(sid)
         self._p(f"Storage {sid} included.")
 
+    def _cmd_consistencycheck(self, args):
+        """Ref: fdbcli consistencycheck — audit replica agreement across
+        every shard's team at the current committed version."""
+        errors = self.db._cluster.consistency_check()
+        if not errors:
+            self._p("Consistency check: PASS")
+        else:
+            self._p(f"Consistency check: FAIL ({len(errors)} errors)")
+            for e in errors[:20]:
+                self._p(f"  {e}")
+
     def _cmd_option(self, args):
         self._p("Option enabled for all transactions")
 

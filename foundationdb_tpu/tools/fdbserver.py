@@ -100,6 +100,12 @@ def main(argv=None):
     server = RpcServer(host or "127.0.0.1", int(port), coord.handlers())
 
     cluster = None
+    if args.coordinator_only and args.cluster_file:
+        print(
+            "warning: --cluster-file is ignored with --coordinator-only "
+            "(clients connect to a database server, not a coordinator)",
+            file=sys.stderr, flush=True,
+        )
     if not args.coordinator_only:
         coordination = None
         if args.coordinators:
