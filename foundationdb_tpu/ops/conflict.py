@@ -77,6 +77,13 @@ class ResolverParams(NamedTuple):
     ring_capacity: int = 4096  # KR
     bucket_bits: int = 14  # C = 2^bucket_bits coarse buckets
     use_pallas: bool = False  # ring lanes via the Pallas VMEM kernel
+    # record point writes into the coarse per-bucket summary even when
+    # this variant has no range-read lanes to read it: set ONLY on the
+    # point-specialized fast-path variant (Resolver), which shares
+    # history with a full kernel whose future range reads must see these
+    # writes. A config that is point-only by knobs (no full twin exists)
+    # keeps the old gate and records nothing nothing can read.
+    record_point_coarse: bool = False
 
 
 class ResolverState(NamedTuple):
@@ -413,7 +420,9 @@ def resolve_batch(
         ht = ht.at[flat_h].max(
             jnp.where(ht_ok, cv, u32(0)), mode="promise_in_bounds"
         )
-        if params.range_reads:  # point_coarse is read only by range reads
+        if params.range_reads or params.record_point_coarse:
+            # read only by range reads, but a point-specialized variant
+            # must still RECORD (the full kernel reads it later)
             val = jnp.where(ok.reshape(-1), cv, u32(0))
             point_coarse = point_coarse.at[
                 jnp.clip(flat_bk, 0, point_coarse.shape[0] - 1)

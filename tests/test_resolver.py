@@ -331,3 +331,60 @@ def test_pallas_ring_lanes_match_jnp_lanes():
     pallas = run_batches(batches, SMALL._replace(use_pallas=True))
     assert plain == pallas
     exact_serializability_check(batches, pallas)
+
+
+def test_point_fast_path_history_visible_to_full_kernel():
+    """The point-only specialized variant records the hash table AND the
+    coarse point summary, so a later range read (full kernel) conflicts
+    with point writes that were resolved on the fast path."""
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import Resolver
+
+    knobs = Knobs(
+        resolver_backend="tpu", batch_txn_capacity=8, point_reads_per_txn=2,
+        point_writes_per_txn=2, range_reads_per_txn=1, range_writes_per_txn=1,
+        key_limbs=2, hash_table_bits=12, range_ring_capacity=16,
+        coarse_buckets_bits=6,
+    )
+    r = Resolver(knobs)
+    assert r._fast is not None
+    # batch 1: pure point writes — must take the fast variant
+    t1 = TxnRequest(read_version=10, point_writes=[b"k5"])
+    assert r.resolve([t1], 20, 0) == [COMMITTED]
+    assert not r._range_history
+    # batch 2: a range read covering k5 at an OLD read version — the full
+    # kernel must see the fast path's write and reject it
+    t2 = TxnRequest(read_version=15, range_reads=[(b"k0", b"k9")])
+    t3 = TxnRequest(read_version=25, range_reads=[(b"k0", b"k9")])
+    assert r.resolve([t2, t3], 30, 0) == [CONFLICT, COMMITTED]
+    # batch 3: a range write makes range history sticky
+    t4 = TxnRequest(read_version=25, range_writes=[(b"a", b"b")])
+    assert r.resolve([t4], 40, 0) == [COMMITTED]
+    assert r._range_history
+    # ...and a point read under it must now conflict via the full kernel
+    t5 = TxnRequest(read_version=35, point_reads=[b"a5"])
+    assert r.resolve([t5], 50, 0) == [CONFLICT]
+
+
+def test_point_write_spill_disables_fast_path_stickily():
+    """A txn whose point writes overflow the lanes is recorded by the
+    packer as a RING range-write — so the fast variant (ring statically
+    off) must never run again, or a later point read misses the spilled
+    write (regression: serializability violation)."""
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import Resolver
+
+    knobs = Knobs(
+        resolver_backend="tpu", batch_txn_capacity=8, point_reads_per_txn=2,
+        point_writes_per_txn=2, range_reads_per_txn=2, range_writes_per_txn=2,
+        key_limbs=2, hash_table_bits=12, range_ring_capacity=32,
+        coarse_buckets_bits=6,
+    )
+    r = Resolver(knobs)
+    # 3 point writes > pw cap 2: k3 spills into the ring lanes
+    t1 = TxnRequest(read_version=10, point_writes=[b"k1", b"k2", b"k3"])
+    assert r.resolve([t1], 20, 0) == [COMMITTED]
+    assert r._range_history  # spill = ring history; fast path is done
+    # pure point read of the SPILLED key at an old read version
+    t2 = TxnRequest(read_version=15, point_reads=[b"k3"])
+    assert r.resolve([t2], 30, 0) == [CONFLICT]
