@@ -217,11 +217,18 @@ class ClusterService:
 
 
 def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16):
-    """Expose a cluster on the network; returns the RpcServer."""
+    """Expose a cluster on the network; returns the RpcServer. Also
+    attaches the log-feed endpoints storage-worker processes pull from
+    (rpc/storageworker.py)."""
+    from foundationdb_tpu.rpc.storageworker import LogFeed
+
     service = ClusterService(cluster)
     server = RpcServer(host, port, service.handlers(),
                        max_workers=max_workers,
                        long_methods={"watch_wait"})
+    # tlog_peek long-polls; it must not occupy the short-RPC pool
+    server.add_handlers(LogFeed(cluster).handlers(),
+                        long_methods={"tlog_peek"})
     TraceEvent("RpcServerStarted").detail(address=server.address).log()
     return server
 
@@ -295,21 +302,51 @@ class _RemoteCommitProxy:
 
 
 class _RemoteStorage:
-    """Read-side surface (router analog) over the wire."""
+    """Read-side surface (router analog) over the wire.
+
+    With worker read-balancing enabled (``RemoteCluster(...,
+    read_workers=True)``), reads round-robin across the lead and any
+    registered storage-worker processes (ref: LoadBalance over storage
+    interfaces); a worker that vanishes is dropped and the read retried
+    on the lead. Watches and writes always go to the lead.
+    """
 
     __slots__ = ("_rc",)
 
     def __init__(self, rc):
         self._rc = rc
 
+    def _read(self, method, *args):
+        from foundationdb_tpu.rpc.transport import RemoteError
+
+        worker = self._rc._next_worker()
+        if worker is not None:
+            try:
+                result = worker.call(method, *args)
+                self._rc._worker_ok(worker)
+                return result
+            except (ConnectionLost, OSError, RemoteError):
+                # dead socket OR a handler that faults server-side: this
+                # worker is not serving; stop routing to it
+                self._rc._drop_worker(worker)
+            except FDBError as e:
+                if e.code != 1009:
+                    raise
+                # future_version = the worker is lagging. Serve this read
+                # from the lead; a worker that keeps lagging (frozen tail
+                # thread) strikes out and is dropped rather than adding a
+                # version-wait stall to every round-robin hit forever.
+                self._rc._worker_strike(worker)
+        return self._rc._call(method, *args)
+
     def get(self, key, rv):
-        return self._rc._call("storage_get", key, rv)
+        return self._read("storage_get", key, rv)
 
     def resolve_selector(self, selector, rv):
-        return self._rc._call("resolve_selector", selector, rv)
+        return self._read("resolve_selector", selector, rv)
 
     def get_range(self, begin, end, rv, limit=0, reverse=False):
-        return self._rc._call("get_range", begin, end, rv, limit, reverse)
+        return self._read("get_range", begin, end, rv, limit, reverse)
 
     def watch(self, key, seen_value):
         wid = self._rc._call("watch_register", key, seen_value)
@@ -320,7 +357,7 @@ class RemoteCluster:
     """The client-side cluster: same attribute surface as
     server.cluster.Cluster, every role call an RPC."""
 
-    def __init__(self, addresses, connect_timeout=5.0):
+    def __init__(self, addresses, connect_timeout=5.0, read_workers=False):
         if isinstance(addresses, str):
             addresses = [addresses]
         self.addresses = list(addresses)
@@ -329,10 +366,15 @@ class RemoteCluster:
         self._client = None
         self._closed = False
         self._knobs = None
+        self._workers = []  # RpcClients to storage-worker processes
+        self._worker_rr = 0
+        self._worker_strikes = {}  # client -> consecutive 1009 lags
         self.grv_proxy = _RemoteGrvProxy(self)
         self.commit_proxy = _RemoteCommitProxy(self)
         self._storage = _RemoteStorage(self)
         self._connect()
+        if read_workers:
+            self.refresh_workers()
 
     @classmethod
     def from_cluster_file(cls, path, **kw):
@@ -409,6 +451,56 @@ class RemoteCluster:
     def consistency_check(self, max_keys_per_shard=None):
         return self._call("consistency_check", max_keys_per_shard)
 
+    # ── storage-worker read balancing ──
+    def refresh_workers(self):
+        """Discover registered storage-worker processes and open read
+        connections (round-robined with the lead thereafter)."""
+        from foundationdb_tpu.rpc.transport import connect_any
+
+        addresses = self._call("list_workers")
+        clients = []
+        for addr in addresses:
+            try:
+                clients.append(connect_any([addr], self._connect_timeout))
+            except ConnectionLost:
+                continue
+        with self._lock:
+            old, self._workers = self._workers, clients
+        for c in old:
+            c.close()
+        return addresses
+
+    def _next_worker(self):
+        """Round-robin over lead + workers: returns None for 'the lead's
+        turn' (callers fall through to _call)."""
+        with self._lock:
+            if not self._workers:
+                return None
+            self._worker_rr = (self._worker_rr + 1) % (len(self._workers) + 1)
+            if self._worker_rr == 0:
+                return None
+            return self._workers[self._worker_rr - 1]
+
+    def _drop_worker(self, client):
+        with self._lock:
+            if client in self._workers:
+                self._workers.remove(client)
+            self._worker_strikes.pop(client, None)
+        client.close()
+
+    WORKER_STRIKE_LIMIT = 3
+
+    def _worker_ok(self, client):
+        with self._lock:
+            self._worker_strikes.pop(client, None)
+
+    def _worker_strike(self, client):
+        with self._lock:
+            n = self._worker_strikes.get(client, 0) + 1
+            self._worker_strikes[client] = n
+        if n >= self.WORKER_STRIKE_LIMIT:
+            self._drop_worker(client)
+
     def connection_string(self):
         return ",".join(self.addresses)
 
@@ -423,3 +515,6 @@ class RemoteCluster:
             if self._client is not None:
                 self._client.close()
                 self._client = None
+            workers, self._workers = self._workers, []
+        for c in workers:
+            c.close()

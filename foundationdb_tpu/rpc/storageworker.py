@@ -1,0 +1,332 @@
+"""Storage servers as separate processes, pulling the mutation stream.
+
+Ref parity: the reference's storage architecture — a storage server is
+its own process that PULLS its mutations from the TLogs (the update
+loop in fdbserver/storageserver.actor.cpp: peek the log cursor, apply
+in version order, advance the durable/read frontier) and serves
+versioned reads, waiting for a version it hasn't caught up to yet
+(watchValue/getValue's version-wait; clients see `future_version` 1009
+— retryable — if the wait times out).
+
+Shape here:
+- the lead process exposes its log over RPC (`tlog_peek`) plus a
+  pop-hold protocol so the durability pump can never discard records a
+  worker hasn't applied (ref: tag-partitioned pop: the log only pops
+  below every cursor);
+- `StorageWorker` bootstraps with a chunked snapshot at a pinned read
+  version (hold first, then pin — no pop race), then tails the log,
+  applying mutations in version order into a local StorageServer;
+- reads on the worker wait for the requested version (bounded), so a
+  client can read-balance across lead + workers with ordinary retry
+  semantics; a stale hold from a dead worker is aged out lead-side so
+  an abandoned cursor cannot pin the log forever.
+"""
+
+import itertools
+import threading
+import time
+
+from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.keys import key_successor
+from foundationdb_tpu.core.mutations import Mutation, Op
+from foundationdb_tpu.rpc.transport import (
+    ConnectionLost,
+    RemoteError,
+    RpcClient,
+    RpcServer,
+)
+from foundationdb_tpu.utils.trace import TraceEvent
+
+SYSTEM_END = b"\xff\xff"
+WORKER_HOLD_TTL_S = 30.0  # a hold not refreshed this long is abandoned
+
+
+class LogFeed:
+    """Lead-side endpoints a worker pulls from (attach to the lead's
+    RpcServer next to the ClusterService handlers)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._holds = {}  # name -> last refresh monotonic
+        self._lock = threading.Lock()
+
+    def handlers(self):
+        return {
+            "tlog_peek": self.tlog_peek,
+            "tlog_floor": self.tlog_floor,
+            "tlog_hold": self.tlog_hold,
+            "tlog_release": self.tlog_release,
+            "worker_register": self.worker_register,
+            "list_workers": self.list_workers,
+        }
+
+    def _prune_stale(self):
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                n for n, ts in self._holds.items()
+                if now - ts > WORKER_HOLD_TTL_S
+            ]
+            for n in stale:
+                del self._holds[n]
+        for n in stale:
+            self.cluster.tlog.release_pop(n)
+            TraceEvent("WorkerHoldExpired", severity=30).detail(name=n).log()
+
+    def tlog_hold(self, name, version):
+        self._prune_stale()
+        self.cluster.tlog.hold_pop(name, version)
+        with self._lock:
+            self._holds[name] = time.monotonic()
+
+    def tlog_release(self, name):
+        self.cluster.tlog.release_pop(name)
+        with self._lock:
+            self._holds.pop(name, None)
+
+    def tlog_peek(self, from_version, limit=512, wait_s=0.0):
+        """With ``wait_s``: block (cheap O(1) last_version poll) until a
+        record newer than from_version exists or the wait expires — a
+        tailing worker long-polls instead of hammering 500 peek RPCs/s
+        at an idle lead. Served from the blocking pool."""
+        self._prune_stale()
+        if wait_s:
+            deadline = time.monotonic() + min(wait_s, 5.0)
+            while (self.cluster.tlog.last_version <= from_version
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+        recs = self.cluster.tlog.peek(from_version)
+        # floor travels WITH the records: a gap (records popped below the
+        # floor before this worker applied them) must be detectable even
+        # on a reply that carries newer records
+        return (self.cluster.tlog._first_version,
+                [(v, list(muts)) for v, muts in recs[:limit]])
+
+    def tlog_floor(self):
+        """Oldest version still retained; a worker whose position is
+        below this has a GAP (records popped unseen) and must
+        re-bootstrap rather than silently tail past it."""
+        return self.cluster.tlog._first_version
+
+    # registry: who serves reads (clients discover via list_workers)
+    _workers = None
+
+    def worker_register(self, address):
+        with self._lock:
+            if self._workers is None:
+                self._workers = {}
+            self._workers[address] = time.monotonic()
+        TraceEvent("StorageWorkerJoined").detail(address=address).log()
+
+    def list_workers(self):
+        with self._lock:
+            if not self._workers:
+                return []
+            now = time.monotonic()
+            return [
+                a for a, ts in self._workers.items()
+                if now - ts < WORKER_HOLD_TTL_S * 10
+            ]
+
+
+class StorageWorker:
+    """One storage-role process: local versioned store + pull loop.
+
+    ``serve()`` starts an RpcServer exposing the read surface
+    (storage_get / get_range / resolve_selector, all version-waiting)
+    and returns it; ``start()`` begins the bootstrap + tail thread.
+    """
+
+    _ids = itertools.count(1)
+
+    def __init__(self, lead_address, window_versions=5_000_000,
+                 chunk=1000, name=None):
+        import os
+
+        from foundationdb_tpu.server.storage import StorageServer
+
+        self.lead_address = lead_address
+        # pid-qualified: two --join PROCESSES must never share a hold
+        # name, or the faster one advances the cursor past the slower
+        # one's position and the pump pops records it still needs
+        self.name = name or f"worker-{os.getpid()}-{next(self._ids)}"
+        self.chunk = chunk
+        self.storage = StorageServer(window_versions=window_versions)
+        self.window_versions = window_versions
+        self.position = 0  # last applied log version
+        self._stop = threading.Event()
+        self._caught_up = threading.Event()
+        self._thread = None
+        self._client = None
+        self._lock = threading.Lock()
+        self._advertise = None  # our serve() address, re-registered on tick
+        self._last_refresh = 0.0
+
+    # ── lead RPC plumbing ──
+    def _call(self, method, *args):
+        with self._lock:
+            if self._client is None or not self._client.alive:
+                host, _, port = self.lead_address.rpartition(":")
+                self._client = RpcClient(host, int(port))
+            client = self._client
+        return client.call(method, *args)
+
+    # ── bootstrap + tail ──
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name=self.name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        from foundationdb_tpu.core.errors import FDBError
+
+        try:
+            self._bootstrap()
+            self._caught_up.set()
+            while not self._stop.is_set():
+                self._tail_once()
+        except (ConnectionLost, RemoteError, OSError, FDBError) as e:
+            # FDBError included: a too-slow bootstrap can get 1007 from
+            # the lead — detach cleanly, don't die with a raw traceback
+            TraceEvent("StorageWorkerDetached", severity=30).detail(
+                name=self.name, error=str(e)[:120]).log()
+
+    def _bootstrap(self, attempts=3):
+        """Snapshot at a pinned version into a FRESH store, then swap it
+        in. A fresh store (not in-place apply) makes re-bootstrap after a
+        log gap correct: keys deleted while we were behind do not
+        survive as stale rows. Retries with a newer version if the
+        snapshot outlives the lead's MVCC window (1007)."""
+        from foundationdb_tpu.core.errors import FDBError
+        from foundationdb_tpu.server.storage import StorageServer
+
+        # hold FIRST (at 0), then pin the snapshot version: the pump can
+        # not pop anything the tail will need, no matter how the grab
+        # and the pump interleave
+        self._call("tlog_hold", self.name, 0)
+        for attempt in range(attempts):
+            rv = self._call("get_read_version")
+            self._call("tlog_hold", self.name, rv)
+            fresh = StorageServer(window_versions=self.window_versions)
+            begin = b""
+            muts = []
+            try:
+                while True:
+                    rows = self._call("get_range", begin, SYSTEM_END, rv,
+                                      self.chunk, False)
+                    muts.extend(Mutation(Op.SET, k, v) for k, v in rows)
+                    if len(rows) < self.chunk:
+                        break
+                    begin = key_successor(rows[-1][0])
+            except FDBError as e:
+                if e.code == 1007 and attempt + 1 < attempts:
+                    continue  # snapshot fell out of the window: re-pin
+                raise
+            if rv > 0:
+                fresh.apply(rv, muts)
+            self.storage = fresh  # atomic swap; readers see the new cut
+            self.position = rv
+            self._last_refresh = time.monotonic()
+            TraceEvent("StorageWorkerBootstrapped").detail(
+                name=self.name, version=rv, rows=len(muts)).log()
+            return
+
+    def _tail_once(self):
+        # long-poll: the lead blocks (cheap) until records exist, so an
+        # idle worker costs ~4 RPCs/s, not 500
+        floor, recs = self._call("tlog_peek", self.position, 512, 0.25)
+        if floor > self.position:
+            # GAP: records in (position, floor] were popped before we
+            # applied them (our hold aged out, or we were reborn) —
+            # tailing past it would silently lose mutations
+            TraceEvent("StorageWorkerGap", severity=30).detail(
+                name=self.name, position=self.position, floor=floor).log()
+            self._bootstrap()
+            return
+        for v, muts in recs:
+            if v <= self.position:
+                continue
+            self.storage.apply(v, muts)
+            self.position = v
+        self.storage.advance_window(
+            max(0, self.position - self.window_versions)
+        )
+        now = time.monotonic()
+        if recs or now - self._last_refresh > WORKER_HOLD_TTL_S / 3:
+            # refresh even when idle: a live worker's hold (and its
+            # read-registry entry) must never age out just because no
+            # commits flowed for a while
+            self._call("tlog_hold", self.name, self.position)
+            if self._advertise is not None:
+                self._call("worker_register", self._advertise)
+            self._last_refresh = now
+
+    def wait_caught_up(self, timeout=30.0):
+        if not self._caught_up.wait(timeout):
+            raise TimeoutError(f"{self.name} never bootstrapped")
+
+    # ── read surface (version-waiting, ref: waitForVersion) ──
+    def _wait_version(self, rv, timeout=5.0):
+        """Returns the storage object that satisfied the wait — reads
+        must use THAT object, since a gap re-bootstrap swaps
+        ``self.storage`` concurrently."""
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self.storage
+            if st.version >= rv:
+                return st
+            if self._stop.is_set() or time.monotonic() > deadline:
+                # behind and not catching up: the client retries (1009)
+                raise err("future_version")
+            time.sleep(0.0005)
+
+    def storage_get(self, key, rv):
+        return self._wait_version(rv).get(key, rv)
+
+    def get_range(self, begin, end, rv, limit, reverse):
+        rows = self._wait_version(rv).get_range(
+            begin, end, rv, limit=limit, reverse=reverse
+        )
+        return [(k, v) for k, v in rows]
+
+    def resolve_selector(self, selector, rv):
+        return self._wait_version(rv).resolve_selector(selector, rv)
+
+    def worker_status(self):
+        return {
+            "name": self.name,
+            "version": self.storage.version,
+            "position": self.position,
+            "caught_up": self._caught_up.is_set(),
+        }
+
+    def handlers(self):
+        return {
+            "storage_get": self.storage_get,
+            "get_range": self.get_range,
+            "resolve_selector": self.resolve_selector,
+            "worker_status": self.worker_status,
+        }
+
+    def serve(self, host="127.0.0.1", port=0):
+        """Expose the read surface; registers with the lead."""
+        server = RpcServer(
+            host, port, self.handlers(),
+            long_methods={"storage_get", "get_range", "resolve_selector"},
+        )
+        self._advertise = server.address  # tail ticks re-register us
+        self._call("worker_register", server.address)
+        return server
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self._call("tlog_release", self.name)
+        except (ConnectionLost, RemoteError, OSError):
+            pass
+        if self._client is not None:
+            self._client.close()

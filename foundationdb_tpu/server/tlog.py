@@ -11,9 +11,11 @@ merge across live replicas, and recovery unions the surviving WALs —
 losing a minority of logs loses no acked commit.
 """
 
+import bisect
 import os
 import pickle
 import struct
+import threading
 import zlib
 
 
@@ -30,6 +32,9 @@ class TLog:
         self.alive = True
         self._wal = open(wal_path, "ab") if wal_path else None
         self._pop_holds = {}  # name -> version: keep records > version
+        # holds mutate on RPC handler threads (remote storage workers)
+        # while the commit pipeline's pop iterates them — lock the dict
+        self._holds_mu = threading.Lock()
 
     def _wal_append(self, record):
         """Length+CRC-framed durable append (one framing for push and
@@ -66,25 +71,32 @@ class TLog:
             self._wal_append(("abort", version))
 
     def peek(self, from_version):
-        """All records with version > from_version, in order."""
+        """All records with version > from_version, in order. The log
+        is version-sorted, so this bisects to the start instead of
+        filtering the whole retained window (storage workers poll)."""
         if not self.alive:
             raise TLogDown()
-        return [(v, m) for v, m in self._log if v > from_version]
+        i = bisect.bisect_right(self._log, from_version, key=lambda r: r[0])
+        return self._log[i:]
 
     def hold_pop(self, name, version):
         """Register a peek cursor: records newer than ``version`` survive
         pop until the holder advances or releases (ref: backup workers'
         pop locks on the tlog)."""
-        self._pop_holds[name] = version
+        with self._holds_mu:
+            self._pop_holds[name] = version
 
     def release_pop(self, name):
-        self._pop_holds.pop(name, None)
+        with self._holds_mu:
+            self._pop_holds.pop(name, None)
 
     def pop(self, up_to_version):
         """Discard records <= up_to_version (applied durably downstream),
         clamped so no registered peek cursor loses unread records."""
-        if self._pop_holds:
-            up_to_version = min(up_to_version, *self._pop_holds.values())
+        with self._holds_mu:
+            holds = list(self._pop_holds.values())
+        if holds:
+            up_to_version = min(up_to_version, *holds)
         self._log = [(v, m) for v, m in self._log if v > up_to_version]
         self._first_version = max(self._first_version, up_to_version)
 

@@ -74,6 +74,10 @@ def main(argv=None):
                         "locks its generation through this quorum")
     p.add_argument("--coordinator-only", action="store_true",
                    help="host only the coordinator replica (no database)")
+    p.add_argument("--join", default=None, metavar="LEAD",
+                   help="run as a storage-worker process: pull the "
+                        "mutation stream from the lead server at this "
+                        "address and serve versioned reads")
     p.add_argument("--storage", type=int, default=1)
     p.add_argument("--resolvers", type=int, default=1)
     p.add_argument("--tlogs", type=int, default=1)
@@ -89,6 +93,28 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     host, _, port = args.listen.rpartition(":")
+
+    if args.join:
+        # storage-worker process: no coordinator, no local cluster —
+        # a local store fed by pulling the lead's log (ref: a storage
+        # process's update loop pulling its tag from the TLogs)
+        from foundationdb_tpu.rpc.storageworker import StorageWorker
+
+        worker = StorageWorker(args.join).start()
+        worker.wait_caught_up()
+        server = worker.serve(host or "127.0.0.1", int(port))
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+        signal.signal(signal.SIGINT, lambda s, f: stop.set())
+        print(f"FDBD listening on {server.address} (storage-worker)",
+              flush=True)
+        TraceEvent("FdbServerUp").detail(
+            address=server.address, role="storage-worker",
+            pid=os.getpid()).log()
+        stop.wait()
+        server.close()
+        worker.close()
+        return 0
 
     # coordinator endpoints come up FIRST: peer recoveries must be able
     # to reach this replica before (and regardless of) any local cluster
@@ -115,6 +141,11 @@ def main(argv=None):
         cluster = build_cluster(args, coordination)
         service = ClusterService(cluster)
         server.add_handlers(service.handlers(), long_methods={"watch_wait"})
+        # log-feed endpoints so --join storage-worker processes can pull
+        from foundationdb_tpu.rpc.storageworker import LogFeed
+
+        server.add_handlers(LogFeed(cluster).handlers(),
+                            long_methods={"tlog_peek"})
         if args.cluster_file:
             write_cluster_file(args.cluster_file, [server.address])
 
