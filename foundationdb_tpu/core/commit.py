@@ -10,12 +10,17 @@ with it JAX) into their import graph.
 
 class CommitRequest:
     __slots__ = ("read_version", "mutations", "read_conflict_ranges",
-                 "write_conflict_ranges", "report_conflicting_keys")
+                 "write_conflict_ranges", "report_conflicting_keys",
+                 "lock_aware")
 
     def __init__(self, read_version, mutations, read_conflict_ranges,
-                 write_conflict_ranges, report_conflicting_keys=False):
+                 write_conflict_ranges, report_conflicting_keys=False,
+                 lock_aware=False):
         self.read_version = read_version
         self.mutations = mutations
         self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
         self.write_conflict_ranges = write_conflict_ranges
         self.report_conflicting_keys = report_conflicting_keys
+        # ref: FDBTransactionOptions LOCK_AWARE — this txn commits even
+        # while the database is locked (lockDatabase in ManagementAPI)
+        self.lock_aware = lock_aware

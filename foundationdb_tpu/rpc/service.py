@@ -117,6 +117,14 @@ class ClusterService:
             "include_storage": self.cluster.include_storage,
             "list_excluded": self.cluster.list_excluded,
             "consistency_check": self.cluster.consistency_check,
+            "lock_database": self.cluster.lock_database,
+            "unlock_database": self.cluster.unlock_database,
+            "lock_uid": self.cluster.lock_uid,
+            "feed_register": self.cluster.change_feeds.register,
+            "feed_read": self.cluster.change_feeds.read,
+            "feed_pop": self.cluster.change_feeds.pop,
+            "feed_deregister": self.cluster.change_feeds.deregister,
+            "feed_list": self.cluster.change_feeds.list,
         }
 
     def hello(self, client_protocol):
@@ -277,6 +285,32 @@ class _RemoteWatch:
         return True
 
 
+class _RemoteChangeFeeds:
+    """Client stub for the change-feed registry endpoints."""
+
+    __slots__ = ("_rc",)
+
+    def __init__(self, rc):
+        self._rc = rc
+
+    def register(self, feed_id, begin, end):
+        return self._rc._call("feed_register", feed_id, begin, end)
+
+    def read(self, feed_id, begin_version, end_version=None, limit=0):
+        return self._rc._call(
+            "feed_read", feed_id, begin_version, end_version, limit
+        )
+
+    def pop(self, feed_id, version):
+        return self._rc._call("feed_pop", feed_id, version)
+
+    def deregister(self, feed_id):
+        return self._rc._call("feed_deregister", feed_id)
+
+    def list(self):
+        return self._rc._call("feed_list")
+
+
 class _RemoteGrvProxy:
     __slots__ = ("_rc",)
 
@@ -371,6 +405,7 @@ class RemoteCluster:
         self._worker_strikes = {}  # client -> consecutive 1009 lags
         self.grv_proxy = _RemoteGrvProxy(self)
         self.commit_proxy = _RemoteCommitProxy(self)
+        self.change_feeds = _RemoteChangeFeeds(self)
         self._storage = _RemoteStorage(self)
         self._connect()
         if read_workers:
@@ -451,6 +486,15 @@ class RemoteCluster:
     def consistency_check(self, max_keys_per_shard=None):
         return self._call("consistency_check", max_keys_per_shard)
 
+    def lock_database(self, uid=b"lock"):
+        return self._call("lock_database", uid)
+
+    def unlock_database(self):
+        return self._call("unlock_database")
+
+    def lock_uid(self):
+        return self._call("lock_uid")
+
     # ── storage-worker read balancing ──
     def refresh_workers(self):
         """Discover registered storage-worker processes and open read
@@ -466,6 +510,8 @@ class RemoteCluster:
                 continue
         with self._lock:
             old, self._workers = self._workers, clients
+            for c in old:
+                self._worker_strikes.pop(c, None)
         for c in old:
             c.close()
         return addresses

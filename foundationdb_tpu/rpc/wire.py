@@ -18,7 +18,7 @@ from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.core.mutations import Mutation, Op
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -90,6 +90,7 @@ def _enc(buf, v):
         _enc(buf, [(bytes(b_), bytes(e_)) for b_, e_ in v.read_conflict_ranges])
         _enc(buf, [(bytes(b_), bytes(e_)) for b_, e_ in v.write_conflict_ranges])
         buf.append(b"T" if v.report_conflicting_keys else b"F")
+        buf.append(b"T" if v.lock_aware else b"F")
     elif isinstance(v, FDBError):
         buf.append(b"e")
         buf.append(struct.pack(">I", v.code))
@@ -167,7 +168,8 @@ def _dec(r: _Reader):
         rcr = _dec(r)
         wcr = _dec(r)
         report = r.take(1) == b"T"
-        return CommitRequest(rv, muts, rcr, wcr, report)
+        lock_aware = r.take(1) == b"T"
+        return CommitRequest(rv, muts, rcr, wcr, report, lock_aware)
     if tag == b"e":
         (code,) = struct.unpack(">I", r.take(4))
         e = FDBError(code)

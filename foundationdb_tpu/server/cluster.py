@@ -160,9 +160,13 @@ class Cluster:
         self._read_rr = itertools.count()  # round-robin read balancing
         self.router = StorageRouter(self.storages, self.dd.map, self._read_rr)
         self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
+        from foundationdb_tpu.server.changefeed import ChangeFeedRegistry
+
+        self.change_feeds = ChangeFeedRegistry()
         self.commit_proxy = CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
             knobs, self.ratekeeper, dd=self.dd,
+            change_feeds=self.change_feeds,
         )
         # ── cross-client batching (ref: CommitProxyServer commitBatcher) ──
         # "thread": a daemon batcher collects concurrent commits into
@@ -316,6 +320,22 @@ class Cluster:
 
     def storage_drained(self, sid):
         return self.dd.storage_owns_nothing(sid)
+
+    def _commit_target(self):
+        """The proxy that actually runs commit_batch (unwrap the
+        batching pipeline wrapper) — lock state lives there."""
+        return getattr(self.commit_proxy, "inner", self.commit_proxy)
+
+    def lock_database(self, uid=b"lock"):
+        """Ref: ManagementAPI lockDatabase — commits from transactions
+        without the lock_aware option fail 1038 until unlocked."""
+        self._commit_target().lock_uid = bytes(uid)
+
+    def unlock_database(self):
+        self._commit_target().lock_uid = None
+
+    def lock_uid(self):
+        return getattr(self._commit_target(), "lock_uid", None)
 
     def consistency_check(self, max_keys_per_shard=None):
         """Replica agreement audit (ref: the ConsistencyCheck workload /

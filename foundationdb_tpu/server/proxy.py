@@ -19,7 +19,7 @@ from foundationdb_tpu.server.tlog import TLogDown
 
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
-                 ratekeeper=None, dd=None):
+                 ratekeeper=None, dd=None, change_feeds=None):
         self.sequencer = sequencer
         self.resolvers = resolvers  # list; key-range sharded when >1
         self.tlog = tlog
@@ -27,6 +27,7 @@ class CommitProxy:
         self.knobs = knobs
         self.ratekeeper = ratekeeper
         self.dd = dd  # data distribution byte accounting
+        self.change_feeds = change_feeds  # ChangeFeedRegistry | None
         self.commit_count = 0
         self.conflict_count = 0
         self._batches_since_pump = 0
@@ -88,6 +89,23 @@ class CommitProxy:
         """
         if not requests:
             return []
+        lock_uid = getattr(self, "lock_uid", None)
+        if lock_uid is not None:
+            # database locked (ref: lockDatabase / error 1038): only
+            # lock-aware transactions pass
+            results = [None] * len(requests)
+            passing = []
+            for i, r in enumerate(requests):
+                if getattr(r, "lock_aware", False):
+                    passing.append((i, r))
+                else:
+                    results[i] = FDBError.from_name("database_locked")
+            if len(passing) < len(requests):
+                if passing:
+                    sub = self.commit_batch([r for _, r in passing])
+                    for (i, _), res in zip(passing, sub):
+                        results[i] = res
+                return results
         cv = self.sequencer.next_commit_version()
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
 
@@ -172,6 +190,11 @@ class CommitProxy:
                 continue
             self.storages[sid].apply(cv, muts)
             self.storages[sid].advance_window(window)
+        if self.change_feeds is not None and batch_mutations:
+            # after the log has the batch (durable order) and before the
+            # version is readable — consumers reading up to a GRV they
+            # observed always see the feed entries for it
+            self.change_feeds.note_commit(cv, batch_mutations)
         self.sequencer.report_committed(cv)
         if self.ratekeeper is not None:
             self.ratekeeper.observe_commit(len(requests), batch_conflicts)

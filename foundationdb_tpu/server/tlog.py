@@ -76,8 +76,12 @@ class TLog:
         filtering the whole retained window (storage workers poll)."""
         if not self.alive:
             raise TLogDown()
-        i = bisect.bisect_right(self._log, from_version, key=lambda r: r[0])
-        return self._log[i:]
+        # snapshot once: pop() swaps the list on the commit thread, and a
+        # bisect index computed against the OLD list applied to the NEW
+        # one would silently skip still-retained records
+        log = self._log
+        i = bisect.bisect_right(log, from_version, key=lambda r: r[0])
+        return log[i:]
 
     def hold_pop(self, name, version):
         """Register a peek cursor: records newer than ``version`` survive

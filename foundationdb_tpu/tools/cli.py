@@ -265,6 +265,16 @@ class Cli:
         cluster.include_storage(sid)
         self._p(f"Storage {sid} included.")
 
+    def _cmd_lock(self, args):
+        """Ref: fdbcli lock — block non-lock-aware commits (1038)."""
+        uid = args[0].encode() if args else b"fdbcli-lock"
+        self.db._cluster.lock_database(uid)
+        self._p(f"Database locked ({uid.decode()}).")
+
+    def _cmd_unlock(self, args):
+        self.db._cluster.unlock_database()
+        self._p("Database unlocked.")
+
     def _cmd_consistencycheck(self, args):
         """Ref: fdbcli consistencycheck — audit replica agreement across
         every shard's team at the current committed version."""

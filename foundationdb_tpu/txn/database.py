@@ -92,6 +92,29 @@ class Database:
 
         return Tenant(self, name)
 
+    # ── change feeds (ref: getChangeFeedStream / change feed API) ──
+    def register_change_feed(self, feed_id, begin, end):
+        """Subscribe ``feed_id`` to every committed mutation touching
+        [begin, end). Mutations stream in commit-version order via
+        read_change_feed."""
+        self._cluster.change_feeds.register(
+            bytes(feed_id), bytes(begin), bytes(end)
+        )
+
+    def read_change_feed(self, feed_id, begin_version, end_version=None,
+                         limit=0):
+        """[(version, [Mutation])] with begin_version < v <= end_version.
+        Raises transaction_too_old below the popped/trimmed frontier."""
+        return self._cluster.change_feeds.read(
+            bytes(feed_id), begin_version, end_version, limit
+        )
+
+    def pop_change_feed(self, feed_id, version):
+        self._cluster.change_feeds.pop(bytes(feed_id), version)
+
+    def deregister_change_feed(self, feed_id):
+        self._cluster.change_feeds.deregister(bytes(feed_id))
+
     def status(self):
         return self._cluster.status()
 

@@ -36,6 +36,7 @@ STATUS_JSON = b"\xff\xff/status/json"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
+DB_LOCKED = b"\xff\xff/management/db_locked"
 
 
 def _excluded_rows(tr):
@@ -46,7 +47,7 @@ def _excluded_rows(tr):
     for op, sid in tr._special_writes:
         if op == "exclude":
             sids.add(sid)
-        else:
+        elif op == "include":
             sids.discard(sid)
     return [(EXCLUDED + str(s).encode(), b"") for s in sorted(sids)]
 
@@ -75,6 +76,14 @@ def get(tr, key):
         return json.dumps(tr.db.status(), sort_keys=True).encode()
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
+    if key == DB_LOCKED:
+        uid = tr._cluster.lock_uid()
+        for op, val in tr._special_writes:
+            if op == "lock":
+                uid = val
+            elif op == "unlock":
+                uid = None
+        return uid
     if key.startswith(CONFLICTING_KEYS):
         for k, v in _conflicting_rows(tr):
             if k == key:
@@ -110,6 +119,9 @@ def write(tr, key, value):
         sid = _parse_sid(key)
         tr._special_writes.append(("exclude", sid))
         return
+    if key == DB_LOCKED:
+        tr._special_writes.append(("lock", value or b"lock"))
+        return
     raise err("key_outside_legal_range")
 
 
@@ -117,6 +129,9 @@ def clear(tr, key):
     if key.startswith(EXCLUDED):
         sid = _parse_sid(key)
         tr._special_writes.append(("include", sid))
+        return
+    if key == DB_LOCKED:
+        tr._special_writes.append(("unlock", None))
         return
     raise err("key_outside_legal_range")
 
@@ -141,10 +156,23 @@ def _parse_sid(key):
 def commit_special(tr):
     """Apply buffered management writes (commit-time semantics, ref:
     SpecialKeySpace::commit). Idempotent operations; failures surface as
-    the commit's error."""
-    for op, sid in tr._special_writes:
+    the commit's error.
+
+    A locked database fences management writes too: unlocking (or any
+    other management change) requires the LOCK_AWARE option, exactly as
+    the reference's unlockDatabase does — otherwise any fenced client
+    could clear the lock through the read-only commit path."""
+    if tr._special_writes and not tr._lock_aware:
+        if tr._cluster.lock_uid() is not None:
+            tr._special_writes = []
+            raise err("database_locked")
+    for op, arg in tr._special_writes:
         if op == "exclude":
-            tr._cluster.exclude_storage(sid)
-        else:
-            tr._cluster.include_storage(sid)
+            tr._cluster.exclude_storage(arg)
+        elif op == "include":
+            tr._cluster.include_storage(arg)
+        elif op == "lock":
+            tr._cluster.lock_database(arg)
+        elif op == "unlock":
+            tr._cluster.unlock_database()
     tr._special_writes = []

@@ -55,6 +55,10 @@ class TransactionOptions:
     def set_report_conflicting_keys(self):
         self._tr._report_conflicting_keys = True
 
+    def set_lock_aware(self):
+        """Ref: LOCK_AWARE — commit even while the database is locked."""
+        self._tr._lock_aware = True
+
     def set_retry_limit(self, n):
         self._tr._retry_limit = int(n)
 
@@ -123,6 +127,7 @@ class Transaction:
         self._snapshot_ryw = True
         self._next_write_no_conflict = False
         self._report_conflicting_keys = False
+        self._lock_aware = False
         self._retry_limit = None
         self._max_retry_delay = self.db._knobs.max_retry_delay_s
         self._timeout_s = None
@@ -422,6 +427,7 @@ class Transaction:
             read_conflict_ranges=_coalesce(self._read_conflicts),
             write_conflict_ranges=_coalesce(self._write_conflicts),
             report_conflicting_keys=self._report_conflicting_keys,
+            lock_aware=self._lock_aware,
         )
 
     def _finish_commit(self, result):

@@ -1,0 +1,111 @@
+"""Change feeds: version-ordered mutation streams over key ranges —
+registration, in/out-of-range filtering, clear-range intersection,
+pop/trim semantics, bounded retention, and the RPC path."""
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.mutations import Op
+from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+from foundationdb_tpu.server.cluster import Cluster
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def db():
+    cluster = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    yield cluster.database()
+    cluster.close()
+
+
+def test_feed_streams_in_range_mutations(db):
+    db.register_change_feed(b"f1", b"a", b"m")
+    db[b"apple"] = b"1"
+    db[b"zebra"] = b"out"  # outside [a, m)
+    db[b"banana"] = b"2"
+    db.clear(b"apple")
+    entries = db.read_change_feed(b"f1", 0)
+    flat = [(m.op, m.key) for _, muts in entries for m in muts]
+    assert (Op.SET, b"apple") in flat
+    assert (Op.SET, b"banana") in flat
+    assert not any(k == b"zebra" for _, k in flat)
+    # versions strictly increase
+    versions = [v for v, _ in entries]
+    assert versions == sorted(versions) and len(set(versions)) == len(versions)
+    # the clear arrives as a CLEAR_RANGE over apple's key range
+    assert any(m.op is Op.CLEAR_RANGE and m.key == b"apple"
+               for _, muts in entries for m in muts)
+
+
+def test_feed_clear_range_intersection(db):
+    db.register_change_feed(b"f", b"k3", b"k6")
+    db.clear_range(b"k0", b"k9")  # overlaps the feed range
+    db.clear_range(b"x", b"z")    # disjoint
+    entries = db.read_change_feed(b"f", 0)
+    assert len(entries) == 1
+    assert entries[0][1][0].op is Op.CLEAR_RANGE
+
+
+def test_feed_windowed_read_and_pop(db):
+    db.register_change_feed(b"f", b"", b"\xff")
+    db[b"k1"] = b"a"
+    v1 = db.read_change_feed(b"f", 0)[-1][0]
+    db[b"k2"] = b"b"
+    db[b"k3"] = b"c"
+    # window read: only entries after v1
+    later = db.read_change_feed(b"f", v1)
+    assert all(v > v1 for v, _ in later)
+    assert len(later) == 2
+    # pop consumes; reading from before the frontier is 1007
+    db.pop_change_feed(b"f", v1)
+    assert db.read_change_feed(b"f", v1) == later
+    with pytest.raises(FDBError) as ei:
+        db.read_change_feed(b"f", 0)
+    assert ei.value.code == 1007
+
+
+def test_feed_retention_trims_with_loud_frontier(db):
+    db._cluster.change_feeds.retention = 5
+    db.register_change_feed(b"f", b"", b"\xff")
+    for i in range(12):
+        db[b"r%02d" % i] = b"x"
+    entries = db.read_change_feed(
+        b"f", db._cluster.change_feeds.list()[b"f"]["pop_version"]
+    )
+    assert len(entries) == 5  # only the newest window retained
+    with pytest.raises(FDBError):
+        db.read_change_feed(b"f", 0)  # trimmed region reads fail loudly
+
+
+def test_feed_duplicate_and_unknown(db):
+    db.register_change_feed(b"f", b"a", b"b")
+    with pytest.raises(FDBError):
+        db.register_change_feed(b"f", b"a", b"b")
+    with pytest.raises(FDBError):
+        db.read_change_feed(b"nope", 0)
+    db.deregister_change_feed(b"f")
+    db.register_change_feed(b"f", b"a", b"b")  # id reusable after dereg
+
+
+def test_feed_over_rpc():
+    cluster = Cluster(resolver_backend="cpu", commit_pipeline="thread",
+                      **TEST_KNOBS)
+    server = serve_cluster(cluster)
+    rc = RemoteCluster([server.address])
+    db = rc.database()
+    try:
+        db.register_change_feed(b"rf", b"u", b"v")
+        db[b"user1"] = b"x"
+        db[b"other"] = b"y"
+        entries = db.read_change_feed(b"rf", 0)
+        assert len(entries) == 1
+        (v, muts), = entries
+        assert muts[0].key == b"user1" and muts[0].param == b"x"
+        assert rc.change_feeds.list()[b"rf"]["entries"] == 1
+        db.pop_change_feed(b"rf", v)
+        assert db.read_change_feed(b"rf", v) == []
+    finally:
+        rc.close()
+        server.close()
+        cluster.close()

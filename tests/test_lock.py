@@ -1,0 +1,99 @@
+"""Database lock/unlock: non-lock-aware commits fail 1038, lock-aware
+transactions pass, management via the special key and fdbcli, and the
+RPC path."""
+
+import io
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.txn import specialkeys
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def db():
+    cluster = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    yield cluster.database()
+    cluster.close()
+
+
+def test_lock_blocks_commits(db):
+    db[b"pre"] = b"x"
+    db._cluster.lock_database(b"uid1")
+    tr = db.create_transaction()
+    tr[b"k"] = b"v"
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1038  # database_locked (not retryable)
+    assert not ei.value.is_retryable
+    # reads are unaffected; lock-aware txns commit
+    assert db.run(lambda tr: tr.get(b"pre")) == b"x"
+    tr2 = db.create_transaction()
+    tr2.options.set_lock_aware()
+    tr2[b"admin"] = b"w"
+    tr2.commit()
+    db._cluster.unlock_database()
+    db[b"post"] = b"y"  # normal commits resume
+    assert db[b"post"] == b"y"
+    assert db[b"admin"] == b"w"
+
+
+def test_lock_via_special_key_and_cli(db):
+    from foundationdb_tpu.tools.cli import Cli
+
+    db.run(lambda tr: tr.set(specialkeys.DB_LOCKED, b"mylock"))
+    assert db._cluster.lock_uid() == b"mylock"
+    # a fenced (non-lock-aware) client must NOT be able to unlock
+    sneaky = db.create_transaction()
+    sneaky.clear(specialkeys.DB_LOCKED)
+    with pytest.raises(FDBError) as ei:
+        sneaky.commit()
+    assert ei.value.code == 1038
+    assert db._cluster.lock_uid() == b"mylock"
+    # unlocking requires LOCK_AWARE (ref: unlockDatabase), with RYW
+    tr = db.create_transaction()
+    tr.options.set_lock_aware()
+    assert tr.get(specialkeys.DB_LOCKED) == b"mylock"
+    tr.clear(specialkeys.DB_LOCKED)
+    assert tr.get(specialkeys.DB_LOCKED) is None
+    tr.commit()
+    assert db._cluster.lock_uid() is None
+    out = io.StringIO()
+    cli = Cli(db, out=out)
+    cli.run_command("lock opslock")
+    assert db._cluster.lock_uid() == b"opslock"
+    cli.run_command("unlock")
+    assert db._cluster.lock_uid() is None
+
+
+def test_lock_over_rpc_and_batched_pipeline():
+    cluster = Cluster(resolver_backend="cpu", commit_pipeline="thread",
+                      **TEST_KNOBS)
+    server = serve_cluster(cluster)
+    rc = RemoteCluster([server.address])
+    db = rc.database()
+    try:
+        db[b"a"] = b"1"
+        rc.lock_database(b"remote")
+        assert rc.lock_uid() == b"remote"
+        tr = db.create_transaction()
+        tr[b"b"] = b"2"
+        with pytest.raises(FDBError) as ei:
+            tr.commit()
+        assert ei.value.code == 1038
+        # lock-aware passes even through the batching pipeline + wire
+        tr2 = db.create_transaction()
+        tr2.options.set_lock_aware()
+        tr2[b"c"] = b"3"
+        tr2.commit()
+        rc.unlock_database()
+        db[b"d"] = b"4"
+        assert db[b"c"] == b"3" and db[b"d"] == b"4"
+    finally:
+        rc.close()
+        server.close()
+        cluster.close()
