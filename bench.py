@@ -290,9 +290,14 @@ def run_e2e(cpu):
     window = int(env("BENCH_E2E_WINDOW", 128 if not cpu else 32))
     seconds = float(env("BENCH_E2E_SECONDS", 8 if not cpu else 3))
     nkeys = int(env("BENCH_E2E_KEYS", 100_000 if not cpu else 10_000))
+    # BENCH_E2E_RESOLVERS=3 reproduces BASELINE.json's sharded-resolver
+    # config: the proxy fans conflict ranges out by key range and joins
+    # the verdicts (ref: multi-resolver commit fan-out)
+    n_resolvers = int(env("BENCH_E2E_RESOLVERS", 1))
     cluster = Cluster(
         commit_pipeline="thread",
         resolver_backend="tpu",
+        n_resolvers=n_resolvers,
         batch_txn_capacity=1024 if not cpu else 128,
         hash_table_bits=20 if not cpu else 15,
         range_ring_capacity=4096 if not cpu else 256,
@@ -360,6 +365,7 @@ def run_e2e(cpu):
     return {
         "e2e_committed_txns_per_sec": round(total / elapsed, 1),
         "e2e_clients": clients * window,
+        "e2e_resolvers": n_resolvers,
         "e2e_mean_batch": round(bp.txns_batched / max(bp.batches_committed, 1), 1),
         "e2e_max_batch": bp.max_batch_seen,
         "e2e_conflict_rate": round(

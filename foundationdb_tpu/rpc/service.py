@@ -117,6 +117,8 @@ class ClusterService:
             "include_storage": self.cluster.include_storage,
             "list_excluded": self.cluster.list_excluded,
             "consistency_check": self.cluster.consistency_check,
+            "estimated_range_size": self.cluster.estimated_range_size_bytes,
+            "range_split_points": self.cluster.range_split_points,
             "lock_database": self.cluster.lock_database,
             "unlock_database": self.cluster.unlock_database,
             "lock_uid": self.cluster.lock_uid,
@@ -485,6 +487,12 @@ class RemoteCluster:
 
     def consistency_check(self, max_keys_per_shard=None):
         return self._call("consistency_check", max_keys_per_shard)
+
+    def estimated_range_size_bytes(self, begin, end):
+        return self._call("estimated_range_size", begin, end)
+
+    def range_split_points(self, begin, end, chunk_size):
+        return self._call("range_split_points", begin, end, chunk_size)
 
     def lock_database(self, uid=b"lock"):
         return self._call("lock_database", uid)

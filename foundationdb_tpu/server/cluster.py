@@ -12,6 +12,7 @@ process for deterministic testing.
 import dataclasses
 import itertools
 
+from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.resolver.resolver import Resolver
 from foundationdb_tpu.server.coordination import (
@@ -320,6 +321,74 @@ class Cluster:
 
     def storage_drained(self, sid):
         return self.dd.storage_owns_nothing(sid)
+
+    def estimated_range_size_bytes(self, begin, end):
+        """Ref: fdb_transaction_get_estimated_range_size_bytes — the
+        DD's sampled per-shard byte counts, prorated for the partially
+        covered boundary shards (same sampling-based estimate the
+        reference serves from storage metrics)."""
+        smap = self.dd.map
+        total = 0
+        for i in smap.shards_overlapping(begin, end):
+            sb, se = smap.shard_range(i)
+            size = smap.sizes[i]
+            if size == 0:
+                continue
+            if sb >= begin and (se is not None and se <= end):
+                total += size  # fully covered
+            else:
+                # boundary shard: prorate by covered key count. Streaming
+                # counts (no row materialization) are bounded because DD
+                # splits shards at max_shard_bytes — a shard never grows
+                # unboundedly large.
+                owner = next(
+                    (self.storages[s] for s in smap.teams[i]
+                     if self.storages[s].alive), None,
+                )
+                if owner is None:
+                    # every read path raises retryable here, not a
+                    # silently smaller answer
+                    raise err("process_behind")
+                lo = max(begin, sb)
+                hi = se if se is not None else b"\xff\xff"
+                hi = min(end, hi)
+                v = owner.version
+                n_all = sum(1 for _ in owner._iter_live(
+                    sb, se if se is not None else b"\xff\xff", v))
+                n_cov = sum(1 for _ in owner._iter_live(lo, hi, v))
+                total += size * n_cov // max(n_all, 1)
+        return total
+
+    def range_split_points(self, begin, end, chunk_size):
+        """Ref: fdb_transaction_get_range_split_points — keys splitting
+        [begin, end) into chunks of roughly chunk_size bytes, derived
+        from an owning storage's actual rows. Returns boundary keys
+        including begin and end."""
+        if chunk_size <= 0:
+            raise err("invalid_option_value")
+        version = self.sequencer.committed_version
+        points = [begin]
+        acc = 0
+        # stream shard by shard (one live replica each) — never
+        # materialize the whole range's rows server-side
+        smap = self.dd.map
+        for i in smap.shards_overlapping(begin, end):
+            sb, se = smap.shard_range(i)
+            lo = max(begin, sb)
+            hi = min(end, se) if se is not None else end
+            owner = next(
+                (self.storages[s] for s in smap.teams[i]
+                 if self.storages[s].alive), None,
+            )
+            if owner is None:
+                raise err("process_behind")
+            for k, v in owner._iter_live(lo, hi, min(version, owner.version)):
+                acc += len(k) + len(v or b"")
+                if acc >= chunk_size:
+                    points.append(k)
+                    acc = 0
+        points.append(end)
+        return points
 
     def _commit_target(self):
         """The proxy that actually runs commit_batch (unwrap the

@@ -405,6 +405,29 @@ class Transaction:
         else:
             self.clear(key)
 
+    # ───────────────────── size/split estimation ──────────────────────
+    def get_estimated_range_size_bytes(self, begin, end):
+        """Ref: fdb_transaction_get_estimated_range_size_bytes (sampled
+        storage metrics — an estimate, not an exact byte count)."""
+        self._guard()
+        return self._cluster.estimated_range_size_bytes(
+            _check_key(begin), _check_key(end)
+        )
+
+    def get_range_split_points(self, begin, end, chunk_size):
+        """Ref: fdb_transaction_get_range_split_points — boundary keys
+        cutting [begin, end) into ~chunk_size-byte chunks (includes both
+        endpoints)."""
+        self._guard()
+        return self._cluster.range_split_points(
+            _check_key(begin), _check_key(end), int(chunk_size)
+        )
+
+    def get_approximate_size(self):
+        """Ref: fdb_transaction_get_approximate_size — the commit
+        payload this transaction has accumulated so far."""
+        return self._size
+
     # ─────────────────────────── watches ──────────────────────────────
     def watch(self, key):
         """Register interest in key changes; activates at commit.

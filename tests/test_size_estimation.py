@@ -1,0 +1,87 @@
+"""Binding-parity size APIs: get_estimated_range_size_bytes (sampled),
+get_range_split_points, get_approximate_size — in-process and over RPC."""
+
+import pytest
+
+from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+from foundationdb_tpu.server.cluster import Cluster
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def db():
+    cluster = Cluster(n_storage=2, replication=1, resolver_backend="cpu",
+                      **TEST_KNOBS)
+    yield cluster.database()
+    cluster.close()
+
+
+def load(db, n=100, vlen=100):
+    for i in range(n):
+        db[b"size%03d" % i] = b"v" * vlen
+
+
+def test_estimated_range_size(db):
+    load(db)
+    db._cluster.rebalance()
+
+    def est(tr):
+        return tr.get_estimated_range_size_bytes(b"size", b"size\xff")
+
+    total = db.run(est)
+    # sampled estimate: right order of magnitude (100 rows x ~107 bytes)
+    assert 2_000 <= total <= 60_000, total
+    # a sub-range estimates smaller than the whole
+    half = db.run(lambda tr: tr.get_estimated_range_size_bytes(
+        b"size000", b"size050"))
+    assert half <= total
+    empty = db.run(lambda tr: tr.get_estimated_range_size_bytes(
+        b"zz", b"zzz"))
+    assert empty == 0
+
+
+def test_range_split_points(db):
+    load(db, n=60, vlen=50)
+    points = db.run(lambda tr: tr.get_range_split_points(
+        b"size", b"size\xff", 500))
+    assert points[0] == b"size" and points[-1] == b"size\xff"
+    assert len(points) > 3  # actually split
+    assert points == sorted(points)
+    # each chunk's rows stay near the chunk size
+    for a, b in zip(points[1:-2], points[2:-1]):
+        rows = db.get_range(a, b)
+        size = sum(len(k) + len(v) for k, v in rows)
+        assert size <= 1000  # chunk + one row slack
+
+
+def test_approximate_size(db):
+    tr = db.create_transaction()
+    assert tr.get_approximate_size() == 0
+    tr[b"k" * 10] = b"v" * 90
+    assert tr.get_approximate_size() == 100
+    tr.clear_range(b"a" * 5, b"b" * 5)
+    assert tr.get_approximate_size() == 110
+
+
+def test_size_apis_over_rpc(db):
+    load(db, n=40)
+    server = serve_cluster(db._cluster)
+    rc = RemoteCluster([server.address])
+    rdb = rc.database()
+    try:
+        est = rdb.run(lambda tr: tr.get_estimated_range_size_bytes(
+            b"size", b"size\xff"))
+        assert est > 0
+        pts = rdb.run(lambda tr: tr.get_range_split_points(
+            b"size", b"size\xff", 800))
+        assert pts[0] == b"size" and pts[-1] == b"size\xff"
+    finally:
+        rc.close()
+        server.close()
+
+
+def test_split_points_invalid_chunk_size(db):
+    with pytest.raises(Exception) as ei:
+        db.run(lambda tr: tr.get_range_split_points(b"a", b"z", 0))
+    assert getattr(ei.value, "code", None) == 2006  # invalid_option_value
