@@ -314,21 +314,51 @@ def run_e2e(cpu):
     conflicts = [0] * clients
     errors = []
 
+    # BENCH_E2E_MODE shapes the client txns to BASELINE.json's configs:
+    #   ycsb (default) — 50% blind update, 50% read-modify-write
+    #   mako           — GRV + get + set on mako-style rows (config 3)
+    #   tpcc           — new-order-shaped: RMW on a hot district counter
+    #                    + order insert + stock updates (config 4's
+    #                    high-contention district rows)
+    e2e_mode = env("BENCH_E2E_MODE", "ycsb")
+    n_districts = int(env("BENCH_E2E_DISTRICTS", 100))
+
+    def build_txn_ycsb(tr, rng_state, j):
+        ids, is_rmw = rng_state
+        k = b"user%08d" % ids[j % 16384]
+        if is_rmw[j % 16384]:
+            tr.get(k)  # adds a real read-conflict range
+        tr.set(k, b"x" * 100)
+
+    def build_txn_mako(tr, rng_state, j):
+        ids, _ = rng_state
+        tr.get(b"mako%08d" % ids[j % 16384])
+        tr.set(b"mako%08d" % ids[(j * 7 + 1) % 16384], b"x" * 100)
+
+    def build_txn_tpcc(tr, rng_state, j):
+        ids, _ = rng_state
+        d = b"district/%05d" % (ids[j % 16384] % n_districts)
+        cur = tr.get(d)  # hot-row RMW: the contention the config is about
+        oid = int(cur or b"0") + 1
+        tr.set(d, str(oid).encode())
+        tr.set(d + b"/order/%08d" % oid, b"o" * 64)
+        tr.set(b"stock/%06d" % ids[(j * 13 + 5) % 16384], b"s" * 32)
+
+    build_txn = {"ycsb": build_txn_ycsb, "mako": build_txn_mako,
+                 "tpcc": build_txn_tpcc}[e2e_mode]
+
     def client(cid):
         rng = np.random.default_rng(1000 + cid)
         ids = rng.integers(0, nkeys, size=16384)
         is_rmw = rng.random(16384) < 0.5
-        val = b"x" * 100
+        rng_state = (ids, is_rmw)
         j = 0
         try:
             while not stop.is_set():
                 trs, futs = [], []
                 for _ in range(window):
                     tr = db.create_transaction()
-                    k = b"user%08d" % ids[j % 16384]
-                    if is_rmw[j % 16384]:
-                        tr.get(k)  # adds a read-conflict range: real OCC
-                    tr.set(k, val)
+                    build_txn(tr, rng_state, j)
                     j += 1
                     trs.append(tr)
                     futs.append(tr.commit_async())
@@ -366,6 +396,7 @@ def run_e2e(cpu):
         "e2e_committed_txns_per_sec": round(total / elapsed, 1),
         "e2e_clients": clients * window,
         "e2e_resolvers": n_resolvers,
+        "e2e_mode": e2e_mode,
         "e2e_mean_batch": round(bp.txns_batched / max(bp.batches_committed, 1), 1),
         "e2e_max_batch": bp.max_batch_seen,
         "e2e_conflict_rate": round(

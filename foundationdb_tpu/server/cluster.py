@@ -337,25 +337,21 @@ class Cluster:
             if sb >= begin and (se is not None and se <= end):
                 total += size  # fully covered
             else:
-                # boundary shard: prorate by covered key count. Streaming
-                # counts (no row materialization) are bounded because DD
-                # splits shards at max_shard_bytes — a shard never grows
-                # unboundedly large.
-                owner = next(
-                    (self.storages[s] for s in smap.teams[i]
-                     if self.storages[s].alive), None,
-                )
-                if owner is None:
-                    # every read path raises retryable here, not a
-                    # silently smaller answer
-                    raise err("process_behind")
+                # boundary shard: prorate by covered key count in ONE
+                # streamed pass (bounded: DD splits shards at
+                # max_shard_bytes). Replica choice rides the router's
+                # load-balanced pick, raising retryable when the whole
+                # team is down like every other read path.
+                owner = self.router._pick(smap.teams[i])
                 lo = max(begin, sb)
                 hi = se if se is not None else b"\xff\xff"
                 hi = min(end, hi)
-                v = owner.version
-                n_all = sum(1 for _ in owner._iter_live(
-                    sb, se if se is not None else b"\xff\xff", v))
-                n_cov = sum(1 for _ in owner._iter_live(lo, hi, v))
+                shard_end = se if se is not None else b"\xff\xff"
+                n_all = n_cov = 0
+                for k, _ in owner._iter_live(sb, shard_end, owner.version):
+                    n_all += 1
+                    if lo <= k < hi:
+                        n_cov += 1
                 total += size * n_cov // max(n_all, 1)
         return total
 
@@ -366,26 +362,23 @@ class Cluster:
         including begin and end."""
         if chunk_size <= 0:
             raise err("invalid_option_value")
+        if begin > end:
+            raise err("inverted_range")
         version = self.sequencer.committed_version
         points = [begin]
         acc = 0
-        # stream shard by shard (one live replica each) — never
-        # materialize the whole range's rows server-side
+        # stream shard by shard (router-picked live replica each) —
+        # never materialize the whole range's rows server-side
         smap = self.dd.map
         for i in smap.shards_overlapping(begin, end):
             sb, se = smap.shard_range(i)
             lo = max(begin, sb)
             hi = min(end, se) if se is not None else end
-            owner = next(
-                (self.storages[s] for s in smap.teams[i]
-                 if self.storages[s].alive), None,
-            )
-            if owner is None:
-                raise err("process_behind")
+            owner = self.router._pick(smap.teams[i])
             for k, v in owner._iter_live(lo, hi, min(version, owner.version)):
                 acc += len(k) + len(v or b"")
-                if acc >= chunk_size:
-                    points.append(k)
+                if acc >= chunk_size and k != points[-1]:
+                    points.append(k)  # strictly increasing boundaries
                     acc = 0
         points.append(end)
         return points

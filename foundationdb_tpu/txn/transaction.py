@@ -426,6 +426,7 @@ class Transaction:
     def get_approximate_size(self):
         """Ref: fdb_transaction_get_approximate_size — the commit
         payload this transaction has accumulated so far."""
+        self._guard()
         return self._size
 
     # ─────────────────────────── watches ──────────────────────────────

@@ -85,3 +85,17 @@ def test_split_points_invalid_chunk_size(db):
     with pytest.raises(Exception) as ei:
         db.run(lambda tr: tr.get_range_split_points(b"a", b"z", 0))
     assert getattr(ei.value, "code", None) == 2006  # invalid_option_value
+
+
+def test_split_points_strictly_increasing_and_inverted(db):
+    db[b"big"] = b"x" * 90  # one row larger than chunk_size
+    pts = db.run(lambda tr: tr.get_range_split_points(b"big", b"bih", 50))
+    assert pts == sorted(set(pts)), pts  # no duplicate boundaries
+    with pytest.raises(Exception) as ei:
+        db.run(lambda tr: tr.get_range_split_points(b"z", b"a", 100))
+    assert getattr(ei.value, "code", None) == 2005  # inverted_range
+    tr = db.create_transaction()
+    tr.cancel()
+    with pytest.raises(Exception) as ei:
+        tr.get_approximate_size()
+    assert getattr(ei.value, "code", None) == 1025
