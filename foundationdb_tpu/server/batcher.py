@@ -134,28 +134,57 @@ class BatchingCommitProxy:
         if due:
             self.flush()
 
+    # cap on batches per backlog dispatch — matches the resolver's fixed
+    # scan width (resolver.BACKLOG_B): one compilation per variant
+    MAX_BACKLOG = 8
+
     def _run_batch(self, pending):
-        while pending:
-            chunk, pending = pending[: self.max_batch], pending[self.max_batch:]
-            try:
-                results = self.inner.commit_batch([r for r, _ in chunk])
-            except Exception as e:  # resolve/apply blew up: fail the chunk
-                # Never propagate: every future must resolve (an escaped
-                # exception would kill the batcher thread and leave later
-                # chunks' clients blocked forever) and the remaining
-                # chunks still deserve their shot. The pipeline may or may
-                # not have made the chunk durable — exactly what
-                # commit_unknown_result (1021) means.
-                self.last_batch_error = e
-                for _, fut in chunk:
-                    fut.set(e if isinstance(e, FDBError) else
-                            FDBError.from_name("commit_unknown_result"))
+        chunks = [
+            pending[i : i + self.max_batch]
+            for i in range(0, len(pending), self.max_batch)
+        ]
+        while chunks:
+            group, chunks = chunks[: self.MAX_BACKLOG], chunks[self.MAX_BACKLOG:]
+            if len(group) > 1 and hasattr(self.inner, "commit_batches"):
+                # a backlog: one resolver dispatch covers every chunk
+                # (ref: the proxy pipelining resolution across batches)
+                try:
+                    results_list = self.inner.commit_batches(
+                        [[r for r, _ in c] for c in group]
+                    )
+                except Exception as e:
+                    self._fail_chunks(group, e)
+                    continue
+                for chunk, results in zip(group, results_list):
+                    self._settle(chunk, results)
                 continue
-            self.batches_committed += 1
-            self.txns_batched += len(chunk)
-            self.max_batch_seen = max(self.max_batch_seen, len(chunk))
-            for (_, fut), res in zip(chunk, results):
-                fut.set(res)
+            for chunk in group:
+                try:
+                    results = self.inner.commit_batch([r for r, _ in chunk])
+                except Exception as e:  # resolve/apply blew up: fail it
+                    # Never propagate: every future must resolve (an
+                    # escaped exception would kill the batcher thread and
+                    # leave later chunks' clients blocked forever) and
+                    # the remaining chunks still deserve their shot. The
+                    # pipeline may or may not have made the chunk durable
+                    # — exactly what commit_unknown_result (1021) means.
+                    self._fail_chunks([chunk], e)
+                    continue
+                self._settle(chunk, results)
+
+    def _settle(self, chunk, results):
+        self.batches_committed += 1
+        self.txns_batched += len(chunk)
+        self.max_batch_seen = max(self.max_batch_seen, len(chunk))
+        for (_, fut), res in zip(chunk, results):
+            fut.set(res)
+
+    def _fail_chunks(self, chunks, e):
+        self.last_batch_error = e
+        for chunk in chunks:
+            for _, fut in chunk:
+                fut.set(e if isinstance(e, FDBError) else
+                        FDBError.from_name("commit_unknown_result"))
 
     def _batcher_loop(self):
         while True:

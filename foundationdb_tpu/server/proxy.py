@@ -108,8 +108,50 @@ class CommitProxy:
                 return results
         cv = self.sequencer.next_commit_version()
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
+        txns = self._build_txns(requests)
+        try:
+            statuses = self._resolve(txns, cv, window)
+        except ResolverDown:
+            # resolution never ran: definitively not committed (1020,
+            # retryable without 1021 disambiguation); the failure monitor
+            # recruits a fenced replacement resolver
+            return [FDBError.from_name("not_committed") for _ in requests]
+        return self._finalize_batch(requests, txns, statuses, cv, window)
 
-        txns = [
+    def commit_batches(self, request_batches):
+        """Commit a BACKLOG of batches: each gets its own commit version,
+        resolution for all of them rides one resolver dispatch
+        (Resolver.resolve_many's scanned path), then each batch finalizes
+        in order. Semantically identical to sequential commit_batch calls
+        — this is the throughput path when commits outrun the link to
+        the chip (ref: the proxy pipelining resolution across batches)."""
+        if getattr(self, "lock_uid", None) is not None or \
+                len(self.resolvers) != 1:
+            return [self.commit_batch(reqs) for reqs in request_batches]
+        metas = []
+        for reqs in request_batches:
+            cv = self.sequencer.next_commit_version()
+            window = max(
+                0, cv - self.knobs.max_read_transaction_life_versions
+            )
+            metas.append((reqs, self._build_txns(reqs), cv, window))
+        try:
+            statuses_list = self.resolvers[0].resolve_many(
+                [(txns, cv, window) for _, txns, cv, window in metas]
+            )
+        except ResolverDown:
+            return [
+                [FDBError.from_name("not_committed") for _ in reqs]
+                for reqs in request_batches
+            ]
+        return [
+            self._finalize_batch(reqs, txns, statuses, cv, window)
+            for (reqs, txns, cv, window), statuses
+            in zip(metas, statuses_list)
+        ]
+
+    def _build_txns(self, requests):
+        return [
             TxnRequest(
                 read_version=r.read_version,
                 point_reads=_points(r.read_conflict_ranges),
@@ -119,14 +161,11 @@ class CommitProxy:
             )
             for r in requests
         ]
-        try:
-            statuses = self._resolve(txns, cv, window)
-        except ResolverDown:
-            # resolution never ran: definitively not committed (1020,
-            # retryable without 1021 disambiguation); the failure monitor
-            # recruits a fenced replacement resolver
-            return [FDBError.from_name("not_committed") for _ in requests]
 
+    def _finalize_batch(self, requests, txns, statuses, cv, window):
+        """Everything after resolution: result assembly, DD accounting,
+        tlog push (1021 on quorum loss), storage apply, change feeds,
+        version reporting, admission + durability pumping."""
         results = []
         batch_mutations = []
         batch_conflicts = 0

@@ -294,3 +294,43 @@ def test_commit_async_inflight_guards_reuse():
     c.commit_proxy.flush()
     tr.commit_finish(fut)
     assert int.from_bytes(db.get(b"ctr"), "little") == 1
+
+
+def test_backlog_dispatches_through_commit_batches():
+    """When the batcher drains a backlog larger than one chunk, the
+    chunks ride one resolver dispatch (commit_batches) and every future
+    resolves with the correct per-txn verdicts."""
+    from foundationdb_tpu.server.cluster import Cluster
+    from conftest import TEST_KNOBS
+
+    cluster = Cluster(resolver_backend="cpu", commit_pipeline="manual",
+                      commit_batch_max=4, **TEST_KNOBS)
+    db = cluster.database()
+    try:
+        db[b"seed"] = b"0"
+        futs, trs = [], []
+        for i in range(11):  # 3 chunks of <=4: a real backlog
+            tr = db.create_transaction()
+            tr.get(b"seed")
+            tr.set(b"k%02d" % i, b"v%d" % i)
+            trs.append(tr)
+            futs.append(tr.commit_async())
+        calls = []
+        orig = cluster.commit_proxy.inner.commit_batches
+
+        def spy(batches):
+            calls.append([len(b) for b in batches])
+            return orig(batches)
+
+        cluster.commit_proxy.inner.commit_batches = spy
+        cluster.commit_proxy.flush()
+        for tr, fut in zip(trs, futs):
+            tr.commit_finish(fut)
+        assert calls == [[4, 4, 3]]
+        for i in range(11):
+            assert db[b"k%02d" % i] == b"v%d" % i
+        # versions differ per chunk (one commit version per batch)
+        versions = {tr.get_committed_version() for tr in trs}
+        assert len(versions) == 3
+    finally:
+        cluster.close()

@@ -388,3 +388,73 @@ def test_point_write_spill_disables_fast_path_stickily():
     # pure point read of the SPILLED key at an old read version
     t2 = TxnRequest(read_version=15, point_reads=[b"k3"])
     assert r.resolve([t2], 30, 0) == [CONFLICT]
+
+
+def test_resolve_many_matches_sequential():
+    """resolve_many (backlog scan dispatch) must produce the exact
+    statuses AND leave the same history as sequential resolve calls."""
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import Resolver
+
+    knobs = Knobs(
+        resolver_backend="tpu", batch_txn_capacity=8, point_reads_per_txn=2,
+        point_writes_per_txn=2, range_reads_per_txn=2, range_writes_per_txn=2,
+        key_limbs=2, hash_table_bits=12, range_ring_capacity=32,
+        coarse_buckets_bits=6,
+    )
+    rng = random.Random(21)
+    version = 100
+
+    def make_batches():
+        nonlocal version
+        out = []
+        for _ in range(7):  # odd count: exercises power-of-two padding
+            n = rng.randrange(1, 8)
+            txns = []
+            for _ in range(n):
+                t = rand_txn(rng, 20, version - rng.randrange(0, 15))
+                if rng.random() < 0.3:
+                    a, b = sorted([b"k%04d" % rng.randrange(20),
+                                   b"k%04d" % rng.randrange(20)])
+                    t.range_writes.append((a, b + b"\xff"))
+                txns.append(t)
+            version += rng.randrange(1, 6)
+            out.append((txns, version, max(0, version - 50)))
+        return out
+
+    batches = make_batches()
+    seq = Resolver(knobs)
+    seq_statuses = [seq.resolve(t, cv, ws) for t, cv, ws in batches]
+    many = Resolver(knobs)
+    many_statuses = many.resolve_many(batches)
+    assert many_statuses == seq_statuses
+    # history equivalence: a follow-up batch resolves identically
+    version += 3
+    follow = ([rand_txn(rng, 20, version - 5) for _ in range(5)],
+              version, max(0, version - 50))
+    assert (seq.resolve(*follow) == many.resolve(*follow))
+
+
+def test_resolve_many_point_only_uses_fast_variant():
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import Resolver
+
+    knobs = Knobs(
+        resolver_backend="tpu", batch_txn_capacity=8, point_reads_per_txn=2,
+        point_writes_per_txn=2, range_reads_per_txn=1, range_writes_per_txn=1,
+        key_limbs=2, hash_table_bits=12, range_ring_capacity=16,
+        coarse_buckets_bits=6,
+    )
+    r = Resolver(knobs)
+    batches = [
+        ([TxnRequest(read_version=10, point_writes=[b"a%d" % i])], 20 + i, 0)
+        for i in range(3)
+    ]
+    out = r.resolve_many(batches)
+    assert out == [[COMMITTED]] * 3
+    assert (False, 8) not in r._scan_fns  # fixed B=8 bucket, fast variant
+    assert (True, 8) in r._scan_fns
+    # writes recorded: an old point read of a1 through resolve() conflicts
+    assert r.resolve(
+        [TxnRequest(read_version=15, point_reads=[b"a1"])], 40, 0
+    ) == [CONFLICT]
