@@ -29,6 +29,15 @@ from foundationdb_tpu.server.tlog import TLog, TLogSystem
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
+def _lock_state(uid):
+    """One consistent snapshot: locked iff a uid exists (including an
+    empty one — an empty uid still fences commits and must not read as
+    unlocked)."""
+    if uid is None:
+        return {"locked": False, "lock_uid": None}
+    return {"locked": True, "lock_uid": uid.decode("utf-8", "replace")}
+
+
 class Cluster:
     def __init__(self, knobs=None, n_resolvers=1, n_storage=1, wal_path=None,
                  version_clock="counter", storage_engines=None,
@@ -468,6 +477,8 @@ class Cluster:
                     "moving_data": False,
                 },
                 "database_available": live_storages > 0,
+                "database_lock_state": _lock_state(self.lock_uid()),
+                "change_feeds": len(self.change_feeds),
                 "degraded": degraded,
                 "recruitments": self.recruitments,
                 "qos": {

@@ -97,3 +97,15 @@ def test_lock_over_rpc_and_batched_pipeline():
         rc.close()
         server.close()
         cluster.close()
+
+
+def test_status_reports_lock_and_feeds(db):
+    st = db.status()["cluster"]
+    assert st["database_lock_state"] == {"locked": False, "lock_uid": None}
+    assert st["change_feeds"] == 0
+    db._cluster.lock_database(b"ops")
+    db.register_change_feed(b"f", b"a", b"b")
+    st = db.status()["cluster"]
+    assert st["database_lock_state"] == {"locked": True, "lock_uid": "ops"}
+    assert st["change_feeds"] == 1
+    db._cluster.unlock_database()
