@@ -27,8 +27,14 @@ def open(cluster_file=None, **kw):
     on the default JAX device.
     """
     if cluster_file is not None or "address" in kw:
+        import os
+
         from foundationdb_tpu.rpc.service import RemoteCluster
 
+        # secured clusters (fdbserver --auth-secret) expect the same
+        # shared secret from every client; the env var mirrors the
+        # server's default so operators configure it once
+        kw.setdefault("secret", os.environ.get("FDB_TPU_AUTH_SECRET"))
         if cluster_file is not None:
             remote = RemoteCluster.from_cluster_file(cluster_file, **kw)
         else:

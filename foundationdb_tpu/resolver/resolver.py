@@ -21,10 +21,36 @@ from foundationdb_tpu.resolver.skiplist import CpuConflictSet
 
 COMMITTED, CONFLICT, TOO_OLD = ck.COMMITTED, ck.CONFLICT, ck.TOO_OLD
 
-# resolve_many's fixed scan width: backlogs (at most this many batches
-# per dispatch — server/batcher.py MAX_BACKLOG matches) pad to exactly
-# this so every backlog size shares one XLA compilation per variant
+# resolve_many's fixed scan width: backlog dispatches pad to a multiple
+# of this (server/batcher.py MAX_BACKLOG matches) so every backlog size
+# shares one XLA compilation per variant; larger backlogs chunk into
+# BACKLOG_B-sized scans rather than falling back to per-batch round
+# trips (the overload case is exactly when batching matters most)
 BACKLOG_B = 8
+
+# Errors the Pallas-ring fallback handler is designed for: the kernel
+# failed to build (Mosaic lowering) or to run (XLA runtime fault) on
+# this backend. Anything else — packer bugs, shape errors from our own
+# code — must propagate, NOT silently wipe the device conflict history.
+_PALLAS_FALLBACK_ERRORS = [jax.errors.JaxRuntimeError, NotImplementedError]
+try:  # Mosaic's TPU lowering failures have their own exception type
+    from jax._src.pallas.mosaic.lowering import LoweringException
+
+    _PALLAS_FALLBACK_ERRORS.append(LoweringException)
+except ImportError:  # pragma: no cover — older/newer jax layouts
+    pass
+_PALLAS_FALLBACK_ERRORS = tuple(_PALLAS_FALLBACK_ERRORS)
+
+
+def _is_pallas_fallback_error(e):
+    """Module-origin check backs up the explicit type list: a private
+    jax error class that moved between versions must still engage the
+    fallback (a Mosaic failure that escapes here fails every commit
+    forever), while errors raised by OUR code keep propagating."""
+    if isinstance(e, _PALLAS_FALLBACK_ERRORS):
+        return True
+    mod = type(e).__module__ or ""
+    return mod.startswith(("jax", "mosaic"))  # jaxlib too ("jax" prefix)
 
 
 class ResolverDown(Exception):
@@ -136,9 +162,12 @@ class Resolver:
                 # here — outside, the fallback would never engage and
                 # self.state would hold poisoned arrays
                 out = np.asarray(status)[: len(chunk)].tolist()
-            except Exception:
-                if not self.params.use_pallas or resolve_fn is not self._resolve:
-                    raise  # pallas only runs in the full variant
+            except Exception as e:
+                if (not self.params.use_pallas
+                        or resolve_fn is not self._resolve
+                        or not _is_pallas_fallback_error(e)):
+                    raise  # pallas only runs in the full variant; non-JAX
+                    # errors (packer bugs …) must not wipe device history
                 # The Pallas ring kernel failed to build/run on this
                 # backend: fall back to the jnp lanes for the life of the
                 # resolver rather than failing every commit. The device
@@ -194,9 +223,17 @@ class Resolver:
         so distinct backlog sizes share compilations.
         """
         if (self.backend != "tpu" or len(batches) <= 1
-                or len(batches) > BACKLOG_B
                 or any(len(t) > self.params.txns for t, _, _ in batches)):
             return [self.resolve(t, cv, ws) for t, cv, ws in batches]
+        if len(batches) > BACKLOG_B:
+            # Oversized backlog — the overload case this path exists for.
+            # Chunk into BACKLOG_B-wide scans (each one dispatch) instead
+            # of collapsing to per-batch round trips: throughput stays
+            # scan-bound, not RTT-bound, no matter how deep the queue.
+            out = []
+            for i in range(0, len(batches), BACKLOG_B):
+                out.extend(self.resolve_many(batches[i:i + BACKLOG_B]))
+            return out
         if not self.alive:
             raise ResolverDown()
         self._maybe_rebase(batches[-1][1])

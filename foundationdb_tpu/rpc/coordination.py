@@ -54,10 +54,12 @@ class RemoteCoordinator:
     CoordinatorDown, which the quorum treats as that replica being
     unreachable — a minority of dead processes is tolerated."""
 
-    def __init__(self, address, connect_timeout=3.0, call_timeout=10.0):
+    def __init__(self, address, connect_timeout=3.0, call_timeout=10.0,
+                 secret=None):
         self.address = address
         self._connect_timeout = connect_timeout
         self._call_timeout = call_timeout
+        self._secret = secret
         self._client = None
         self.alive = True  # parity with the in-process replica surface
 
@@ -66,7 +68,8 @@ class RemoteCoordinator:
             if self._client is None or not self._client.alive:
                 host, _, port = self.address.rpartition(":")
                 self._client = RpcClient(
-                    host, int(port), self._connect_timeout
+                    host, int(port), self._connect_timeout,
+                    secret=self._secret,
                 )
             return self._client.call(
                 method, *args, timeout=self._call_timeout
@@ -98,14 +101,14 @@ class RemoteCoordinator:
             self._client = None
 
 
-def remote_quorum(addresses, proposer_id=None):
+def remote_quorum(addresses, proposer_id=None, secret=None):
     """A CoordinationQuorum over coordinator processes at ``addresses``
     (each a ``host:port`` whose RpcServer registers CoordinatorService
     handlers). Proposer ids are drawn at random from a 64-bit space so
     independent recovering processes stride disjoint ballot sequences."""
     if proposer_id is None:
         proposer_id = random.getrandbits(64)
-    coords = [RemoteCoordinator(a) for a in addresses]
+    coords = [RemoteCoordinator(a, secret=secret) for a in addresses]
     return CoordinationQuorum(
         coords, proposer_id=proposer_id, n_proposers=BALLOT_STRIDE
     )

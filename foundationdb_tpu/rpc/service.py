@@ -226,16 +226,19 @@ class ClusterService:
         return False
 
 
-def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16):
+def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16,
+                  secret=None):
     """Expose a cluster on the network; returns the RpcServer. Also
     attaches the log-feed endpoints storage-worker processes pull from
-    (rpc/storageworker.py)."""
+    (rpc/storageworker.py). ``secret`` enables the transport's
+    shared-secret handshake — required before listening on a
+    non-loopback interface (the surface includes management access)."""
     from foundationdb_tpu.rpc.storageworker import LogFeed
 
     service = ClusterService(cluster)
     server = RpcServer(host, port, service.handlers(),
                        max_workers=max_workers,
-                       long_methods={"watch_wait"})
+                       long_methods={"watch_wait"}, secret=secret)
     # tlog_peek long-polls; it must not occupy the short-RPC pool
     server.add_handlers(LogFeed(cluster).handlers(),
                         long_methods={"tlog_peek"})
@@ -393,11 +396,13 @@ class RemoteCluster:
     """The client-side cluster: same attribute surface as
     server.cluster.Cluster, every role call an RPC."""
 
-    def __init__(self, addresses, connect_timeout=5.0, read_workers=False):
+    def __init__(self, addresses, connect_timeout=5.0, read_workers=False,
+                 secret=None):
         if isinstance(addresses, str):
             addresses = [addresses]
         self.addresses = list(addresses)
         self._connect_timeout = connect_timeout
+        self._secret = secret
         self._lock = threading.Lock()
         self._client = None
         self._closed = False
@@ -428,7 +433,9 @@ class RemoteCluster:
                 return self._client
             if self._client is not None:
                 self._client.close()  # release the dead socket's fd
-            self._client = connect_any(self.addresses, self._connect_timeout)
+            self._client = connect_any(
+                self.addresses, self._connect_timeout, secret=self._secret
+            )
             hello = self._client.call("hello", PROTOCOL_VERSION)
             generation = hello["generation"]
             prior = getattr(self, "server_generation", None)
@@ -513,7 +520,9 @@ class RemoteCluster:
         clients = []
         for addr in addresses:
             try:
-                clients.append(connect_any([addr], self._connect_timeout))
+                clients.append(connect_any(
+                    [addr], self._connect_timeout, secret=self._secret
+                ))
             except ConnectionLost:
                 continue
         with self._lock:

@@ -85,16 +85,16 @@ class LogFeed:
             self._holds.pop(name, None)
 
     def tlog_peek(self, from_version, limit=512, wait_s=0.0):
-        """With ``wait_s``: block (cheap O(1) last_version poll) until a
+        """With ``wait_s``: park on the log's push condition until a
         record newer than from_version exists or the wait expires — a
         tailing worker long-polls instead of hammering 500 peek RPCs/s
-        at an idle lead. Served from the blocking pool."""
+        at an idle lead, and the parked thread costs zero CPU (the push
+        path signals it). Served from the blocking pool."""
         self._prune_stale()
-        if wait_s:
-            deadline = time.monotonic() + min(wait_s, 5.0)
-            while (self.cluster.tlog.last_version <= from_version
-                   and time.monotonic() < deadline):
-                time.sleep(0.001)
+        if wait_s and self.cluster.tlog.last_version <= from_version:
+            self.cluster.tlog.wait_for_version(
+                from_version + 1, timeout=min(wait_s, 5.0)
+            )
         recs = self.cluster.tlog.peek(from_version)
         # floor travels WITH the records: a gap (records popped below the
         # floor before this worker applied them) must be detectable even
@@ -140,12 +140,13 @@ class StorageWorker:
     _ids = itertools.count(1)
 
     def __init__(self, lead_address, window_versions=5_000_000,
-                 chunk=1000, name=None):
+                 chunk=1000, name=None, secret=None):
         import os
 
         from foundationdb_tpu.server.storage import StorageServer
 
         self.lead_address = lead_address
+        self.secret = secret
         # pid-qualified: two --join PROCESSES must never share a hold
         # name, or the faster one advances the cursor past the slower
         # one's position and the pump pops records it still needs
@@ -167,7 +168,8 @@ class StorageWorker:
         with self._lock:
             if self._client is None or not self._client.alive:
                 host, _, port = self.lead_address.rpartition(":")
-                self._client = RpcClient(host, int(port))
+                self._client = RpcClient(host, int(port),
+                                         secret=self.secret)
             client = self._client
         return client.call(method, *args)
 
@@ -315,6 +317,7 @@ class StorageWorker:
         server = RpcServer(
             host, port, self.handlers(),
             long_methods={"storage_get", "get_range", "resolve_selector"},
+            secret=self.secret,
         )
         self._advertise = server.address  # tail ticks re-register us
         self._call("worker_register", server.address)

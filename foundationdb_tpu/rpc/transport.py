@@ -10,8 +10,19 @@ futures, and server handlers run on a shared pool so a blocking endpoint
 
 Frame: 4-byte big-endian length + wire payload.
 Request: ("q", seq, method, args-tuple)  Reply: ("r", seq, ok, payload).
+
+Authentication: with a shared ``secret`` configured, every connection
+starts with a challenge/response — the server sends a random nonce, the
+client must answer HMAC-SHA256(secret, nonce) before any request is
+read (ref: FlowTransport's TLS handshake gating endpoint access; ours
+is a shared-secret MAC rather than certificates). Without a secret the
+transport is open: listening on a non-loopback interface without one
+exposes full read/write/management access and is unsafe.
 """
 
+import hashlib
+import hmac
+import os
 import socket
 import struct
 import threading
@@ -22,6 +33,14 @@ from foundationdb_tpu.rpc import wire
 from foundationdb_tpu.utils.trace import TraceEvent
 
 MAX_FRAME = 64 * 1024 * 1024
+_AUTH_CONTEXT = b"fdbtpu-rpc-auth-v1:"
+_AUTH_HANDSHAKE_TIMEOUT_S = 5.0
+
+
+def _auth_proof(secret, nonce):
+    if isinstance(secret, str):
+        secret = secret.encode()
+    return hmac.new(secret, _AUTH_CONTEXT + nonce, hashlib.sha256).digest()
 
 
 class ConnectionLost(ConnectionError):
@@ -64,7 +83,8 @@ class RpcServer:
     """
 
     def __init__(self, host, port, handlers, max_workers=16,
-                 long_methods=()):
+                 long_methods=(), secret=None):
+        self.secret = secret
         self.handlers = dict(handlers)
         # endpoints that legitimately block (watch waits) run on their
         # own pool so parked waiters cannot starve short RPCs
@@ -129,9 +149,37 @@ class RpcServer:
                 name=f"rpc-conn-{peer}", daemon=True,
             ).start()
 
+    def _authenticate(self, sock, send_lock, peer):
+        """Challenge/response before the first request frame. The
+        handshake runs under a timeout so an idle port-scanner cannot
+        park a connection thread forever."""
+        nonce = os.urandom(16)
+        _send_frame(sock, send_lock, nonce)
+        sock.settimeout(_AUTH_HANDSHAKE_TIMEOUT_S)
+        try:
+            # pre-auth frames are capped at the proof size (32 bytes):
+            # an unauthenticated peer must not be able to make us buffer
+            # a MAX_FRAME allocation before the HMAC check rejects it
+            (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+            if n > 64:
+                raise ConnectionLost(f"oversized auth proof: {n}")
+            proof = _recv_exact(sock, n)
+        finally:
+            sock.settimeout(None)
+        if not hmac.compare_digest(proof, _auth_proof(self.secret, nonce)):
+            TraceEvent("RpcAuthFailed", severity=30).detail(
+                peer=str(peer)).log()
+            raise ConnectionLost("authentication failed")
+        # confirmation frame: the client learns its proof was accepted
+        # before sending requests, so a secret mismatch surfaces as a
+        # deterministic handshake failure, not a later dead socket
+        _send_frame(sock, send_lock, b"\x00ok")
+
     def _serve_conn(self, sock, peer):
         send_lock = threading.Lock()
         try:
+            if self.secret is not None:
+                self._authenticate(sock, send_lock, peer)
             while not self._closed.is_set():
                 frame = _recv_frame(sock)
                 kind, seq, method, args = wire.loads(frame)
@@ -212,11 +260,34 @@ class RemoteError(RuntimeError):
 class RpcClient:
     """One connection to an RpcServer; thread-safe, multiplexed calls."""
 
-    def __init__(self, host, port, connect_timeout=5.0):
+    def __init__(self, host, port, connect_timeout=5.0, secret=None):
         self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
+        if secret is not None:
+            # the server's first frame is the auth nonce; answer before
+            # the reader thread starts interpreting frames as replies
+            self._sock.settimeout(_AUTH_HANDSHAKE_TIMEOUT_S)
+            try:
+                nonce = _recv_frame(self._sock)
+                _send_frame(self._sock, self._send_lock,
+                            _auth_proof(secret, nonce))
+                if _recv_frame(self._sock) != b"\x00ok":
+                    raise ConnectionLost("bad auth confirmation")
+                self._sock.settimeout(None)
+            except (OSError, ConnectionLost) as e:
+                # a server not configured for auth never sends a nonce:
+                # fail fast with the real cause (and no leaked socket)
+                # instead of surfacing as generic unreachability
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise ConnectionLost(
+                    f"auth handshake with {host}:{port} failed — secret "
+                    f"mismatch or server not configured for auth: {e!r}"
+                ) from e
         self._state_lock = threading.Lock()
         self._pending = {}  # seq -> Future
         self._seq = 0
@@ -302,14 +373,14 @@ class RpcClient:
             pass
 
 
-def connect_any(addresses, connect_timeout=5.0):
+def connect_any(addresses, connect_timeout=5.0, secret=None):
     """Try each ``host:port`` in turn; first reachable wins (ref: the
     client walking the coordinator list in the cluster file)."""
     last = None
     for addr in addresses:
         host, _, port = addr.rpartition(":")
         try:
-            return RpcClient(host, int(port), connect_timeout)
+            return RpcClient(host, int(port), connect_timeout, secret=secret)
         except OSError as e:
             last = e
     raise ConnectionLost(

@@ -134,9 +134,11 @@ class BatchingCommitProxy:
         if due:
             self.flush()
 
-    # cap on batches per backlog dispatch — matches the resolver's fixed
-    # scan width (resolver.BACKLOG_B): one compilation per variant
-    MAX_BACKLOG = 8
+    # cap on batches per commit_batches call. The resolver chunks the
+    # backlog into BACKLOG_B-wide scans internally, so this only bounds
+    # how much queue drains per settle round (keeping client latency and
+    # host-side packing memory bounded), not the dispatch width.
+    MAX_BACKLOG = 64
 
     def _run_batch(self, pending):
         chunks = [

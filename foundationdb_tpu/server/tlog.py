@@ -35,6 +35,9 @@ class TLog:
         # holds mutate on RPC handler threads (remote storage workers)
         # while the commit pipeline's pop iterates them — lock the dict
         self._holds_mu = threading.Lock()
+        # long-polling peekers (rpc/storageworker.py LogFeed) park here
+        # instead of sleep-polling last_version
+        self._data_cond = threading.Condition()
 
     def _wal_append(self, record):
         """Length+CRC-framed durable append (one framing for push and
@@ -56,6 +59,27 @@ class TLog:
             raise ValueError("tlog push out of order")
         self._log.append((version, mutations))
         self._wal_append((version, mutations))
+        with self._data_cond:
+            self._data_cond.notify_all()
+
+    def wait_for_version(self, version, timeout):
+        """Park until a record at/after ``version`` exists (or timeout).
+        The long-poll half of peek: a tailing storage worker blocks here
+        at zero CPU instead of the lead burning a thread at 1 kHz
+        wakeups per idle worker. Death/close wakes waiters immediately
+        (kill()/close() notify) so shutdown never stalls on the timeout."""
+        with self._data_cond:
+            return self._data_cond.wait_for(
+                lambda: self.last_version >= version or not self.alive,
+                timeout=timeout,
+            )
+
+    def kill(self):
+        """Process death (simulation / failure injection): wake parked
+        long-pollers so they observe the dead log now, not at timeout."""
+        self.alive = False
+        with self._data_cond:
+            self._data_cond.notify_all()
 
     def rollback(self, version):
         """Undo a just-pushed tail record that failed to reach its
@@ -109,6 +133,9 @@ class TLog:
         return self._log[-1][0] if self._log else self._first_version
 
     def close(self):
+        self.alive = False
+        with self._data_cond:
+            self._data_cond.notify_all()
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -166,6 +193,7 @@ class TLogSystem:
             for i in range(n)
         ]
         self._pop_holds = {}
+        self._data_cond = threading.Condition()
 
     @staticmethod
     def replica_paths(wal_path, n):
@@ -173,7 +201,9 @@ class TLogSystem:
 
     # ── replica lifecycle (simulation / failure detection hooks) ──
     def kill(self, i):
-        self.logs[i].alive = False
+        self.logs[i].kill()
+        with self._data_cond:
+            self._data_cond.notify_all()
 
     def revive(self, i):
         """A rebooted replica rejoins caught-up from a live peer (ref: a
@@ -230,6 +260,18 @@ class TLogSystem:
             raise TLogDown(
                 f"{len(accepted)}/{self.n} tlogs acked (need {self.quorum})"
             )
+        with self._data_cond:
+            self._data_cond.notify_all()
+
+    def wait_for_version(self, version, timeout):
+        """Park until a quorum-acked record at/after ``version`` exists
+        (long-poll support; see TLog.wait_for_version)."""
+        with self._data_cond:
+            return self._data_cond.wait_for(
+                lambda: self.live_count == 0
+                or self.last_version >= version,
+                timeout=timeout,
+            )
 
     def peek(self, from_version):
         """Merged view across live replicas: the union of their records
@@ -265,6 +307,8 @@ class TLogSystem:
     def close(self):
         for log in self.logs:
             log.close()
+        with self._data_cond:
+            self._data_cond.notify_all()
 
     @classmethod
     def recover(cls, wal_path, n):

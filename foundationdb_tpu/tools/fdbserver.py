@@ -90,9 +90,21 @@ def main(argv=None):
                    choices=["tpu", "cpu", "native"])
     p.add_argument("--monitor-interval", type=float, default=0.5,
                    help="failure-detection round interval, seconds")
+    p.add_argument("--auth-secret", default=None,
+                   help="shared secret for the transport handshake; every "
+                        "process and client of the cluster must use the "
+                        "same one (defaults to $FDB_TPU_AUTH_SECRET)")
     args = p.parse_args(argv)
+    secret = args.auth_secret or os.environ.get("FDB_TPU_AUTH_SECRET")
 
     host, _, port = args.listen.rpartition(":")
+    if secret is None and host not in ("", "127.0.0.1", "localhost", "::1"):
+        print(
+            "warning: --listen on a non-loopback interface without "
+            "--auth-secret exposes unauthenticated read/write/management "
+            "access to anyone who can reach the port",
+            file=sys.stderr, flush=True,
+        )
 
     if args.join:
         # storage-worker process: no coordinator, no local cluster —
@@ -100,7 +112,7 @@ def main(argv=None):
         # process's update loop pulling its tag from the TLogs)
         from foundationdb_tpu.rpc.storageworker import StorageWorker
 
-        worker = StorageWorker(args.join).start()
+        worker = StorageWorker(args.join, secret=secret).start()
         worker.wait_caught_up()
         server = worker.serve(host or "127.0.0.1", int(port))
         stop = threading.Event()
@@ -123,7 +135,8 @@ def main(argv=None):
         os.makedirs(args.dir, exist_ok=True)
         coord_path = os.path.join(args.dir, "coordinator.json")
     coord = CoordinatorService(coord_path)
-    server = RpcServer(host or "127.0.0.1", int(port), coord.handlers())
+    server = RpcServer(host or "127.0.0.1", int(port), coord.handlers(),
+                       secret=secret)
 
     cluster = None
     if args.coordinator_only and args.cluster_file:
@@ -136,7 +149,8 @@ def main(argv=None):
         coordination = None
         if args.coordinators:
             coordination = remote_quorum(
-                [a.strip() for a in args.coordinators.split(",")]
+                [a.strip() for a in args.coordinators.split(",")],
+                secret=secret,
             )
         cluster = build_cluster(args, coordination)
         service = ClusterService(cluster)

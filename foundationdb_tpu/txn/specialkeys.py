@@ -107,6 +107,12 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         (k, v) for k, v in _conflicting_rows(tr) if begin <= k < end
     ]
     rows += [(k, v) for k, v in _excluded_rows(tr) if begin <= k < end]
+    if begin <= DB_LOCKED < end:
+        # same RYW overlay as the point get; the row exists only while
+        # locked (an unlocked database has no db_locked row to list)
+        uid = get(tr, DB_LOCKED)
+        if uid is not None:
+            rows.append((DB_LOCKED, uid))
     rows.sort(reverse=reverse)
     if limit:
         rows = rows[:limit]

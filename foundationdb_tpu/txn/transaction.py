@@ -455,6 +455,15 @@ class Transaction:
         )
 
     def _finish_commit(self, result):
+        """Mixed data+management transactions are NOT atomic: the data
+        commit becomes durable first, then the buffered special-key
+        writes apply. ``commit()`` re-checks the lock up front so a
+        locked database rejects the whole transaction before any data
+        commits; if a lock races in between the two halves, the data
+        commit stands (it passed the proxy's lock check) and the fenced
+        management writes are dropped with a trace — they are exactly
+        the writes the new lock exists to fence, and raising here would
+        falsely report a durably-committed transaction as failed."""
         if isinstance(result, FDBError):
             self._state = "error"
             # conflict reporting: the failed txn's conflicting read ranges
@@ -464,11 +473,29 @@ class Transaction:
                 result, "conflicting_key_ranges", None
             )
             raise result
-        specialkeys.commit_special(self)
+        try:
+            specialkeys.commit_special(self)
+        except FDBError as e:
+            if e.description != "database_locked":
+                raise
+            from foundationdb_tpu.utils.trace import TraceEvent
+
+            TraceEvent("ManagementWritesFencedByLock", severity=30).detail(
+                committed_version=result).log()
         self._committed_version = result
         self._versionstamp = Versionstamp.from_version(result).tr_version
         self._state = "committed"
         self._activate_watches()
+
+    def _precheck_special_lock(self):
+        """A mixed data+management transaction checks the lock BEFORE the
+        data commit: without this, a lock landing between the (durable)
+        data commit and the management application would surface as a
+        non-retryable database_locked on a transaction whose data already
+        committed (see _finish_commit for the remaining race)."""
+        if self._special_writes and not self._lock_aware \
+                and self._cluster.lock_uid() is not None:
+            raise err("database_locked")
 
     def commit(self):
         self._guard()
@@ -479,6 +506,7 @@ class Transaction:
             self._state = "committed"
             self._activate_watches()
             return
+        self._precheck_special_lock()
         self._finish_commit(
             self._cluster.commit_proxy.commit(self._build_commit_request())
         )
@@ -496,11 +524,15 @@ class Transaction:
         if not self._mutation_log and not self._write_conflicts:
             from foundationdb_tpu.server.batcher import CommitFuture
 
+            # same contract as commit()'s read-only path: management-only
+            # transactions still apply their buffered special writes
+            specialkeys.commit_special(self)
             self._state = "committed"
             self._activate_watches()
             fut = CommitFuture()
             fut.set(None)
             return fut
+        self._precheck_special_lock()
         req = self._build_commit_request()
         # in-flight: further ops (or a second commit) must fail
         # used_during_commit, not silently re-submit the mutation log

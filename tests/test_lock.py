@@ -109,3 +109,38 @@ def test_status_reports_lock_and_feeds(db):
     assert st["database_lock_state"] == {"locked": True, "lock_uid": "ops"}
     assert st["change_feeds"] == 1
     db._cluster.unlock_database()
+
+
+def test_db_locked_row_in_management_range_scan(db):
+    """A range scan of \\xff\\xff/management/ lists the lock state the
+    point get reports — including this transaction's RYW overlay."""
+    def scan(tr):
+        return dict(tr.get_range(b"\xff\xff/management/",
+                                 b"\xff\xff/management0"))
+
+    assert specialkeys.DB_LOCKED not in db.run(scan)
+    db._cluster.lock_database(b"uidX")
+    rows = db.run(lambda tr, s=scan: s(tr))
+    assert rows[specialkeys.DB_LOCKED] == b"uidX"
+    # RYW overlay: an uncommitted unlock hides the row from this txn
+    tr = db.create_transaction()
+    tr.options.set_lock_aware()
+    tr.clear(specialkeys.DB_LOCKED)
+    assert specialkeys.DB_LOCKED not in scan(tr)
+    tr.commit()
+    assert specialkeys.DB_LOCKED not in db.run(scan)
+
+
+def test_mixed_data_management_txn_checks_lock_before_commit(db):
+    """A mixed data+management transaction on a locked database fails
+    database_locked WITHOUT committing its data half (the pre-commit
+    lock check closes the non-atomicity window up front)."""
+    db._cluster.lock_database(b"uid")
+    tr = db.create_transaction()
+    tr[b"data-key"] = b"v"
+    tr.set(specialkeys.DB_LOCKED, b"other")  # management write, no LOCK_AWARE
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1038
+    db._cluster.unlock_database()
+    assert db.run(lambda tr: tr.get(b"data-key")) is None

@@ -458,3 +458,48 @@ def test_resolve_many_point_only_uses_fast_variant():
     assert r.resolve(
         [TxnRequest(read_version=15, point_reads=[b"a1"])], 40, 0
     ) == [CONFLICT]
+
+
+def test_resolve_many_chunks_oversized_backlog():
+    """A backlog deeper than BACKLOG_B chunks into BACKLOG_B-wide scan
+    dispatches (never per-batch round trips) and still matches
+    sequential resolution exactly."""
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import BACKLOG_B, Resolver
+
+    knobs = Knobs(
+        resolver_backend="tpu", batch_txn_capacity=8, point_reads_per_txn=2,
+        point_writes_per_txn=2, range_reads_per_txn=2, range_writes_per_txn=2,
+        key_limbs=2, hash_table_bits=12, range_ring_capacity=32,
+        coarse_buckets_bits=6,
+    )
+    rng = random.Random(77)
+    version = 100
+    batches = []
+    for _ in range(BACKLOG_B * 2 + 3):  # 19: two full chunks + remainder
+        txns = [
+            rand_txn(rng, 20, version - rng.randrange(0, 15))
+            for _ in range(rng.randrange(1, 8))
+        ]
+        version += rng.randrange(1, 6)
+        batches.append((txns, version, max(0, version - 60)))
+
+    seq = Resolver(knobs)
+    seq_statuses = [seq.resolve(t, cv, ws) for t, cv, ws in batches]
+    many = Resolver(knobs)
+    resolved = {"n": 0}
+    orig = Resolver.resolve
+
+    def counting_resolve(self, *a, **kw):
+        resolved["n"] += 1
+        return orig(self, *a, **kw)
+
+    try:
+        Resolver.resolve = counting_resolve
+        many_statuses = many.resolve_many(batches)
+    finally:
+        Resolver.resolve = orig
+    assert many_statuses == seq_statuses
+    # the 3-batch remainder chunk may legitimately ride resolve() when
+    # small, but the two full chunks must NOT have fallen back per-batch
+    assert resolved["n"] <= 3

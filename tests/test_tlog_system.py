@@ -151,3 +151,29 @@ def test_quorum_failed_push_rolled_back_never_resurrects(tmp_path):
     db2 = c2.database()
     assert db2[b"limbo"] is None
     assert db2[b"a"] == b"1" and db2[b"later"] == b"y"
+
+
+def test_wait_for_version_wakes_on_push():
+    """The long-poll primitive (rpc/storageworker.py LogFeed.tlog_peek):
+    a parked waiter wakes promptly when a push lands — no sleep-polling."""
+    import threading
+    import time
+
+    from foundationdb_tpu.server.tlog import TLog, TLogSystem
+
+    for log in (TLog(), TLogSystem(3)):
+        assert log.wait_for_version(1, timeout=0.05) is False  # empty: times out
+        woke = []
+
+        def waiter():
+            t0 = time.monotonic()
+            ok = log.wait_for_version(1, timeout=5.0)
+            woke.append((ok, time.monotonic() - t0))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        log.push(1, [])
+        th.join(timeout=2)
+        assert woke and woke[0][0] is True
+        assert woke[0][1] < 1.0  # woke on the push signal, not the timeout
