@@ -60,7 +60,8 @@ class ShardedResolverKernel:
     resolvers on.
     """
 
-    def __init__(self, params: ck.ResolverParams, mesh=None, donate=True):
+    def __init__(self, params: ck.ResolverParams, mesh=None, donate=True,
+                 make_state=True):
         ck.validate_params(params)
         self.params = params
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -93,7 +94,9 @@ class ShardedResolverKernel:
         self._scan_step = jax.jit(
             scan_sharded, donate_argnums=(0,) if donate else ()
         )
-        self.state = self.init_state()
+        # make_state=False: a caller sharing state with a twin kernel
+        # (MeshResolver's point-fast variant) skips the throwaway arrays
+        self.state = self.init_state() if make_state else None
 
     def init_state(self):
         p, n = self.params, self.n

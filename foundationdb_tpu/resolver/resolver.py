@@ -58,6 +58,36 @@ class ResolverDown(Exception):
     not_committed and the cluster controller recruits a replacement."""
 
 
+def params_from_knobs(knobs, use_pallas=False):
+    """The one knobs→ResolverParams mapping (Resolver and MeshResolver
+    must size their kernels identically or verdicts drift)."""
+    return ck.ResolverParams(
+        txns=knobs.batch_txn_capacity,
+        point_reads=knobs.point_reads_per_txn,
+        point_writes=knobs.point_writes_per_txn,
+        range_reads=knobs.range_reads_per_txn,
+        range_writes=knobs.range_writes_per_txn,
+        key_width=knobs.key_limbs + 1,
+        hash_bits=knobs.hash_table_bits,
+        ring_capacity=knobs.range_ring_capacity,
+        bucket_bits=knobs.coarse_buckets_bits,
+        use_pallas=use_pallas,
+    )
+
+
+def fast_params_of(params):
+    """The point-specialized variant's params: range lanes statically
+    off, point writes still recorded into the coarse summary the full
+    kernel's future range reads consult. None when the config has no
+    range lanes to specialize away."""
+    if not (params.range_reads or params.range_writes):
+        return None
+    return params._replace(
+        range_reads=0, range_writes=0, use_pallas=False,
+        record_point_coarse=True,
+    )
+
+
 class Resolver:
     def __init__(self, knobs=DEFAULT_KNOBS, base_version=0):
         self.knobs = knobs
@@ -69,18 +99,7 @@ class Resolver:
             use_pallas = pallas == "on" or (
                 pallas == "auto" and jax.default_backend() == "tpu"
             )
-            self.params = ck.ResolverParams(
-                txns=knobs.batch_txn_capacity,
-                point_reads=knobs.point_reads_per_txn,
-                point_writes=knobs.point_writes_per_txn,
-                range_reads=knobs.range_reads_per_txn,
-                range_writes=knobs.range_writes_per_txn,
-                key_width=knobs.key_limbs + 1,
-                hash_bits=knobs.hash_table_bits,
-                ring_capacity=knobs.range_ring_capacity,
-                bucket_bits=knobs.coarse_buckets_bits,
-                use_pallas=use_pallas,
-            )
+            self.params = params_from_knobs(knobs, use_pallas=use_pallas)
             self.packer = BatchPacker(self.params)
             self.state = ck.init_state(self.params)
             self._resolve = ck.make_resolve_fn(self.params)
@@ -93,17 +112,12 @@ class Resolver:
             # coarse point summary, so a later range read through the
             # full kernel sees every point write it must conflict with).
             self._fast = None
-            self._fast_params = None
+            self._fast_params = fast_params_of(self.params)
             self._range_history = False
-            if self.params.range_reads or self.params.range_writes:
-                fast_params = self.params._replace(
-                    range_reads=0, range_writes=0, use_pallas=False,
-                    record_point_coarse=True,
-                )
-                self._fast_params = fast_params
+            if self._fast_params is not None:
                 self._fast = (
-                    BatchPacker(fast_params),
-                    ck.make_resolve_fn(fast_params),
+                    BatchPacker(self._fast_params),
+                    ck.make_resolve_fn(self._fast_params),
                 )
             # scan fns for backlog dispatch (resolve_many), cached per
             # (variant, padded batch count) — each (fast, B) pair is one
@@ -127,6 +141,18 @@ class Resolver:
         replacement must fence pre-death read versions (ref: resolver
         failure forcing a recovery in the reference)."""
         self.alive = False
+
+    def respawn(self, base_version):
+        """A replacement of this resolver's own kind, fenced at
+        ``base_version`` (the failure monitor's recruitment hook —
+        subclasses recruit their own shape)."""
+        return type(self)(self.knobs, base_version=base_version)
+
+    def _make_scan_fn(self, use_fast):
+        """Compile the multi-batch scan for resolve_many (subclasses
+        swap in their mesh-sharded twin)."""
+        params = self._fast_params if use_fast else self.params
+        return ck.make_resolve_scan_fn(params)
 
     def resolve(self, txns, commit_version, new_window_start):
         """txns: list[TxnRequest] in arrival order → list of statuses."""
@@ -251,7 +277,6 @@ class Resolver:
             all_live.extend(t for _, t in live)
         use_fast = self._pick_fast(all_live)
         packer = self._fast[0] if use_fast else self.packer
-        params = self._fast_params if use_fast else self.params
         packed = [
             packer.pack([t for _, t in live], self.base_version, cv, ws)
             for statuses, live, cv, ws in per_batch
@@ -269,7 +294,7 @@ class Resolver:
         key = (use_fast, B)
         scan_fn = self._scan_fns.get(key)
         if scan_fn is None:
-            scan_fn = ck.make_resolve_scan_fn(params)
+            scan_fn = self._make_scan_fn(use_fast)
             self._scan_fns[key] = scan_fn
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *packed)
         self.state, st = scan_fn(self.state, stacked)

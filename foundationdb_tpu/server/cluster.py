@@ -131,9 +131,23 @@ class Cluster:
         self.sequencer = Sequencer(
             version_clock=version_clock, start_version=recovered
         )
-        self.resolvers = [
-            Resolver(knobs, base_version=recovered) for _ in range(n_resolvers)
-        ]
+        # Multi-resolver TPU deployments run the fleet as ONE mesh
+        # program (hash/bucket-sharded history, psum verdicts over ICI)
+        # rather than n host-side resolvers — the proxy drives it through
+        # the ordinary single-resolver path, backlog dispatch included.
+        # cpu/native backends keep the key-range-sharded host fan-out
+        # (the reference's process shape). See resolver/meshresolver.py.
+        if knobs.resolver_backend == "tpu" and n_resolvers > 1:
+            from foundationdb_tpu.resolver.meshresolver import MeshResolver
+
+            self.resolvers = [MeshResolver(
+                knobs, base_version=recovered, n_lanes=n_resolvers,
+            )]
+        else:
+            self.resolvers = [
+                Resolver(knobs, base_version=recovered)
+                for _ in range(n_resolvers)
+            ]
         # Placement: replication defaults to n_storage (every storage a
         # full replica); replication < n_storage partitions the keyspace
         # into shards owned by teams of that size, with the commit proxy
@@ -224,10 +238,11 @@ class Cluster:
                 # every pre-death read version (it cannot check them), so
                 # its window opens at the current committed version —
                 # in-flight txns retry with fresh reads (ref: resolver
-                # failure forcing a recovery that fences the old epoch)
-                self.resolvers[i] = Resolver(
-                    self.knobs,
-                    base_version=self.sequencer.committed_version,
+                # failure forcing a recovery that fences the old epoch).
+                # respawn() recruits the instance's own kind (a mesh
+                # fleet recruits a mesh fleet).
+                self.resolvers[i] = r.respawn(
+                    self.sequencer.committed_version
                 )
                 events.append(("resolver", i))
         for sid, s in enumerate(self.storages):
@@ -501,7 +516,8 @@ class Cluster:
                 "processes": {
                     "resolvers": [
                         {"id": i, "alive": r.alive,
-                         "backend": self.knobs.resolver_backend}
+                         "backend": self.knobs.resolver_backend,
+                         "lanes": getattr(r, "n_lanes", 1)}
                         for i, r in enumerate(self.resolvers)
                     ],
                     "storage_servers": [
@@ -516,7 +532,9 @@ class Cluster:
                     ],
                     "logs": tlog_info,
                 },
-                "resolvers": len(self.resolvers),
+                "resolvers": sum(
+                    getattr(r, "n_lanes", 1) for r in self.resolvers
+                ),
                 "resolver_backend": self.knobs.resolver_backend,
                 "storage_servers": len(self.storages),
             }

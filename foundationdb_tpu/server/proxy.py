@@ -8,6 +8,8 @@ to keep batches full (the opposite pressure from the reference, whose
 resolver cost grows with batch size).
 """
 
+import threading
+
 from foundationdb_tpu.core.commit import CommitRequest  # noqa: F401  (re-export)
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
@@ -30,6 +32,14 @@ class CommitProxy:
         self.change_feeds = change_feeds  # ChangeFeedRegistry | None
         self.commit_count = 0
         self.conflict_count = 0
+        # Concurrent client threads may drive the synchronous proxy
+        # directly (no batching wrapper): the pipeline mutates shared
+        # state (donated resolver buffers, tlog order, storage overlay),
+        # so commits serialize here. Reentrant: the lock path re-enters
+        # commit_batch for lock-aware sub-batches. Uncontended cost is
+        # noise; deterministic sims are single-threaded so ordering is
+        # unchanged. (Ref: the proxy's commit path is one actor.)
+        self._commit_mu = threading.RLock()
         self._batches_since_pump = 0
         self.pump_interval = 64  # batches between flush + ratekeeper rounds
         self.resolver_bounds = None  # n-1 split keys; None = static split
@@ -68,11 +78,9 @@ class CommitProxy:
                     bounds.append(smap.boundaries[i + 1])
             new_bounds = bounds if len(bounds) == n - 1 else None
         if new_bounds != self.resolver_bounds and fence:
-            from foundationdb_tpu.resolver.resolver import Resolver
-
             cv = self.sequencer.committed_version
             for i in range(n):
-                self.resolvers[i] = Resolver(self.knobs, base_version=cv)
+                self.resolvers[i] = self.resolvers[i].respawn(cv)
         self.resolver_bounds = new_bounds
 
     def commit(self, request):
@@ -89,6 +97,10 @@ class CommitProxy:
         """
         if not requests:
             return []
+        with self._commit_mu:
+            return self._commit_batch_locked(requests)
+
+    def _commit_batch_locked(self, requests):
         lock_uid = getattr(self, "lock_uid", None)
         if lock_uid is not None:
             # database locked (ref: lockDatabase / error 1038): only
@@ -125,9 +137,20 @@ class CommitProxy:
         in order. Semantically identical to sequential commit_batch calls
         — this is the throughput path when commits outrun the link to
         the chip (ref: the proxy pipelining resolution across batches)."""
-        if getattr(self, "lock_uid", None) is not None or \
-                len(self.resolvers) != 1:
+        if len(self.resolvers) != 1:
             return [self.commit_batch(reqs) for reqs in request_batches]
+        with self._commit_mu:
+            if getattr(self, "lock_uid", None) is not None:
+                # checked UNDER the mutex: a lock landing while this
+                # backlog queued must fence it exactly as commit_batch
+                # would (the per-batch path re-checks per batch)
+                return [
+                    self._commit_batch_locked(reqs)
+                    for reqs in request_batches
+                ]
+            return self._commit_batches_locked(request_batches)
+
+    def _commit_batches_locked(self, request_batches):
         metas = []
         for reqs in request_batches:
             cv = self.sequencer.next_commit_version()

@@ -98,7 +98,8 @@ def main(argv=None):
     secret = args.auth_secret or os.environ.get("FDB_TPU_AUTH_SECRET")
 
     host, _, port = args.listen.rpartition(":")
-    if secret is None and host not in ("", "127.0.0.1", "localhost", "::1"):
+    if secret is None and host not in ("", "127.0.0.1", "localhost",
+                                       "::1", "[::1]"):
         print(
             "warning: --listen on a non-loopback interface without "
             "--auth-secret exposes unauthenticated read/write/management "

@@ -1,0 +1,210 @@
+"""The live cluster driving the mesh-sharded resolver fleet:
+`Cluster(n_resolvers=k, resolver_backend="tpu")` runs ONE shard_map
+dispatch over a k-lane mesh through the ordinary commit path (VERDICT r2
+item 2). Runs on the 8-virtual-CPU-device mesh from conftest."""
+
+import random
+
+import pytest
+
+import jax
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.resolver.meshresolver import MeshResolver
+from foundationdb_tpu.server.cluster import Cluster
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def mesh_cluster():
+    assert len(jax.devices()) >= 4
+    c = Cluster(n_resolvers=4, resolver_backend="tpu", **TEST_KNOBS)
+    yield c
+    c.close()
+
+
+def test_cluster_constructs_mesh_resolver(mesh_cluster):
+    (r,) = mesh_cluster.resolvers
+    assert isinstance(r, MeshResolver)
+    assert r.n_lanes == 4
+    st = mesh_cluster.status()["cluster"]
+    assert st["resolvers"] == 4  # lanes, not host objects
+    assert st["processes"]["resolvers"][0]["lanes"] == 4
+
+
+def test_mesh_resolver_occ_through_commit_path(mesh_cluster):
+    """Conflict semantics through the full commit pipeline: first
+    writer wins, stale reader conflicts, fresh retry commits."""
+    db = mesh_cluster.database()
+    db[b"k"] = b"v0"
+
+    t1 = db.create_transaction()
+    t2 = db.create_transaction()
+    assert t1.get(b"k") == b"v0"
+    assert t2.get(b"k") == b"v0"
+    t1[b"k"] = b"t1"
+    t2[b"k"] = b"t2"
+    t1.commit()
+    with pytest.raises(FDBError) as ei:
+        t2.commit()
+    assert ei.value.code == 1020
+    t2.on_error(ei.value)  # reset + backoff
+    assert t2.get(b"k") == b"t1"
+    t2[b"k"] = b"t2"
+    t2.commit()
+    assert db[b"k"] == b"t2"
+
+
+def test_mesh_resolver_matches_cpu_backend_on_scripted_workload():
+    """Differential: the mesh fleet and the exact CPU conflict set give
+    identical verdicts on a collision-free scripted history replayed
+    through two clusters (point + range ops)."""
+    rng = random.Random(11)
+    script = []
+    for i in range(120):
+        kind = rng.random()
+        key = b"key%03d" % rng.randrange(40)
+        if kind < 0.55:
+            script.append(("set", key, b"v%d" % i))
+        elif kind < 0.8:
+            script.append(("swap", key, b"key%03d" % rng.randrange(40)))
+        else:
+            lo, hi = sorted(
+                [b"key%03d" % rng.randrange(40),
+                 b"key%03d" % rng.randrange(40)]
+            )
+            script.append(("clear_range", lo, hi + b"\xff"))
+
+    def run(cluster):
+        db = cluster.database()
+        outcomes = []
+        stale = None  # a transaction held open to age across commits
+        for step, (op, a, b) in enumerate(script):
+            if stale is None:
+                stale = db.create_transaction()
+                stale.get(a)  # pin a read at the old version
+                stale_key = a
+            tr = db.create_transaction()
+            if op == "set":
+                tr.get(a)
+                tr[a] = b
+            elif op == "swap":
+                va, vb = tr.get(a), tr.get(b)
+                tr[a], tr[b] = vb or b"x", va or b"y"
+            else:
+                list(tr.get_range(a, b))
+                tr.clear_range(a, b)
+            tr.commit()
+            if step % 10 == 9:
+                # the aged transaction writes its pinned key: conflicts
+                # iff someone wrote it (or its range) since
+                stale[stale_key] = b"stale"
+                try:
+                    stale.commit()
+                    outcomes.append("ok")
+                except FDBError as e:
+                    outcomes.append(e.code)
+                stale = None
+        rows = db.run(lambda tr: list(tr.get_range(b"key", b"kez")))
+        return outcomes, rows
+
+    mesh = Cluster(n_resolvers=4, resolver_backend="tpu", **TEST_KNOBS)
+    cpu = Cluster(n_resolvers=1, resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        out_mesh = run(mesh)
+        out_cpu = run(cpu)
+    finally:
+        mesh.close()
+        cpu.close()
+    assert out_mesh == out_cpu
+
+
+def test_mesh_resolver_backlog_dispatch():
+    """commit_batches (the scanned backlog path) runs through the mesh
+    fleet — statuses identical to sequential commit_batch calls."""
+    from foundationdb_tpu.server.proxy import CommitRequest
+
+    def batches_for(cluster):
+        db = cluster.database()
+        db[b"seed"] = b"s"
+        rv = cluster.grv_proxy.get_read_version()
+        out = []
+        for g in range(12):  # > BACKLOG_B: exercises chunking too
+            reqs = []
+            for t in range(4):
+                key = b"bk%02d" % ((g * 4 + t) % 10)
+                reqs.append(CommitRequest(
+                    read_version=rv,
+                    mutations=[],
+                    read_conflict_ranges=[(key, key + b"\x00")],
+                    write_conflict_ranges=[(key, key + b"\x00")],
+                ))
+            out.append(reqs)
+        return out
+
+    mesh = Cluster(n_resolvers=4, resolver_backend="tpu", **TEST_KNOBS)
+    try:
+        reqs = batches_for(mesh)
+        got = mesh.commit_proxy.commit_batches(reqs)
+        # replay the same shape sequentially on a fresh mesh cluster
+        mesh2 = Cluster(n_resolvers=4, resolver_backend="tpu", **TEST_KNOBS)
+        try:
+            reqs2 = batches_for(mesh2)
+            want = [mesh2.commit_proxy.commit_batch(rs) for rs in reqs2]
+        finally:
+            mesh2.close()
+        norm = lambda results: [
+            ["v" if not isinstance(r, FDBError) else r.code for r in rs]
+            for rs in results
+        ]
+        assert norm(got) == norm(want)
+        # first writer of each key commits; later same-key writers with
+        # the same stale read version conflict
+        flat = [r for rs in norm(got) for r in rs]
+        assert flat.count("v") == 10 and flat.count(1020) == 38
+    finally:
+        mesh.close()
+
+
+def test_mesh_resolver_kill_recruit_fences(mesh_cluster):
+    """Failure monitor recruits a fresh mesh fleet; pre-death read
+    versions are fenced TOO_OLD and a fresh retry commits."""
+    db = mesh_cluster.database()
+    db[b"a"] = b"1"
+    tr = db.create_transaction()
+    tr.get(b"a")  # pin pre-death read version
+    tr[b"a"] = b"2"
+    mesh_cluster.resolvers[0].kill()
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1020  # ResolverDown → not_committed
+    events = mesh_cluster.detect_and_recruit()
+    assert ("resolver", 0) in events
+    (r,) = mesh_cluster.resolvers
+    assert isinstance(r, MeshResolver) and r.alive and r.n_lanes == 4
+    tr.on_error(ei.value)
+    tr[b"a"] = b"2"
+    tr.commit()
+    assert db[b"a"] == b"2"
+
+
+def test_concurrent_client_threads_on_sync_pipeline(mesh_cluster):
+    """Regression (round-3 verify drive): client threads hammering the
+    default sync pipeline raced the donated resolver state ("buffer
+    donated" crashes). The proxy now serializes commits."""
+    import threading
+
+    db = mesh_cluster.database()
+    db[b"c"] = (0).to_bytes(8, "little")
+
+    def worker():
+        for _ in range(8):
+            db.run(lambda tr: tr.add(b"c", (1).to_bytes(8, "little")))
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert int.from_bytes(db[b"c"], "little") == 32

@@ -230,7 +230,8 @@ def test_resolver_ranges_follow_dd_map(cluster):
     """With >1 resolver the proxy derives per-resolver key ranges from
     the live shard map, weighted by sampled bytes — not a static
     first-byte split (round-1 weakness #4)."""
-    c = Cluster(n_storage=2, n_resolvers=2, **TEST_KNOBS)
+    c = Cluster(n_storage=2, n_resolvers=2, resolver_backend="cpu",
+                **TEST_KNOBS)  # host fan-out path (tpu uses the mesh fleet)
     c.dd.max_shard_bytes = 2000  # split aggressively at test scale
     db = c.database()
     # skew traffic: nearly all bytes land in [m, n)
@@ -262,7 +263,8 @@ def test_resolver_boundary_move_fences_stale_reads():
     serializability violation."""
     from foundationdb_tpu.core.errors import FDBError
 
-    c = Cluster(n_storage=2, n_resolvers=2, **TEST_KNOBS)
+    c = Cluster(n_storage=2, n_resolvers=2, resolver_backend="cpu",
+                **TEST_KNOBS)  # host fan-out path (tpu uses the mesh fleet)
     c.dd.max_shard_bytes = 2000
     db = c.database()
     db.set(b"k", b"0")
