@@ -514,15 +514,20 @@ def scan_of(step_fn):
     return scan_step
 
 
-def make_resolve_scan_fn(params: ResolverParams, donate=True):
+def make_resolve_scan_fn(params: ResolverParams, donate=True,
+                         keep_pallas=False):
     """jit-compiled *multi-batch* resolver step: ``lax.scan`` threads the
     history through a stack of batches (leading axis B) in one dispatch.
 
-    The scan path always runs the jnp ring lanes: measured on v5e, the
-    Pallas ring kernel wins the single-step latency path (~1.65x faster
-    kernel step — it is what make_resolve_fn uses) but loses inside
-    lax.scan, where XLA overlaps the fused jnp lanes across iterations
-    better than it schedules repeated pallas_call launches.
+    By default the scan path runs the jnp ring lanes: measured on v5e,
+    the Pallas ring kernel wins the single-step latency path (~1.65x
+    faster kernel step — it is what make_resolve_fn uses) but loses
+    inside lax.scan on POINT workloads, where XLA overlaps the fused jnp
+    lanes across scan iterations better than it schedules repeated
+    pallas_call launches. ``keep_pallas=True`` keeps the Pallas ring
+    inside the scan — the right call when the ring walk dominates the
+    step (range-heavy workloads), where its VMEM tiling beats the
+    overlap XLA loses.
 
     Semantics are identical to calling ``resolve_batch`` B times in order
     — the scan carry is the same sequential state dependency — but one
@@ -533,7 +538,8 @@ def make_resolve_scan_fn(params: ResolverParams, donate=True):
     Returns (state, statuses[B, T]).
     """
     validate_params(params)
-    params = params._replace(use_pallas=False)
+    if not keep_pallas:
+        params = params._replace(use_pallas=False)
     scan_step = scan_of(lambda s, b: resolve_batch(s, b, params))
     return jax.jit(scan_step, donate_argnums=(0,) if donate else ())
 

@@ -355,10 +355,10 @@ class _RemoteStorage:
     def __init__(self, rc):
         self._rc = rc
 
-    def _read(self, method, *args):
+    def _read(self, method, *args, span=None):
         from foundationdb_tpu.rpc.transport import RemoteError
 
-        worker = self._rc._next_worker()
+        worker = self._rc._next_worker(span)
         if worker is not None:
             try:
                 result = worker.call(method, *args)
@@ -379,13 +379,17 @@ class _RemoteStorage:
         return self._rc._call(method, *args)
 
     def get(self, key, rv):
-        return self._read("storage_get", key, rv)
+        return self._read("storage_get", key, rv,
+                          span=(key, key + b"\x00"))
 
     def resolve_selector(self, selector, rv):
+        # selectors can walk past their anchor key: only a worker
+        # serving the WHOLE keyspace may resolve one (span=None)
         return self._read("resolve_selector", selector, rv)
 
     def get_range(self, begin, end, rv, limit=0, reverse=False):
-        return self._read("get_range", begin, end, rv, limit, reverse)
+        return self._read("get_range", begin, end, rv, limit, reverse,
+                          span=(begin, end))
 
     def watch(self, key, seen_value):
         wid = self._rc._call("watch_register", key, seen_value)
@@ -513,45 +517,73 @@ class RemoteCluster:
     # ── storage-worker read balancing ──
     def refresh_workers(self):
         """Discover registered storage-worker processes and open read
-        connections (round-robined with the lead thereafter)."""
+        connections (round-robined with the lead thereafter). Each
+        entry may carry the worker's served key ranges (tag-scoped
+        workers — rpc/storageworker.py); reads route by coverage."""
         from foundationdb_tpu.rpc.transport import connect_any
 
-        addresses = self._call("list_workers")
+        entries = self._call("list_workers")
         clients = []
-        for addr in addresses:
+        addresses = []
+        for entry in entries:
+            if isinstance(entry, (list, tuple)):
+                addr, ranges = entry
+                ranges = ([tuple(r) for r in ranges]
+                          if ranges is not None else None)
+            else:  # legacy bare-address registration
+                addr, ranges = entry, None
+            addresses.append(addr)
             try:
-                clients.append(connect_any(
+                clients.append((connect_any(
                     [addr], self._connect_timeout, secret=self._secret
-                ))
+                ), ranges))
             except ConnectionLost:
                 continue
         with self._lock:
             old, self._workers = self._workers, clients
-            for c in old:
+            for c, _ in old:
                 self._worker_strikes.pop(c, None)
-        for c in old:
+        for c, _ in old:
             c.close()
         return addresses
 
-    def _next_worker(self):
-        """Round-robin over lead + workers: returns None for 'the lead's
-        turn' (callers fall through to _call)."""
+    @staticmethod
+    def _covers(ranges, span):
+        """Whether a worker serving ``ranges`` can answer a read over
+        ``span`` ([begin, end), or None = requires the full keyspace).
+        Ranges arrive merged, so containment in ONE range suffices."""
+        if ranges is None:
+            return True
+        if span is None:
+            return False
+        b, e = span
+        return any(rb <= b and e <= re_ for rb, re_ in ranges)
+
+    def _next_worker(self, span=None):
+        """Round-robin over lead + covering workers: returns None for
+        'the lead's turn' (callers fall through to _call)."""
         with self._lock:
-            if not self._workers:
+            eligible = [
+                c for c, ranges in self._workers
+                if self._covers(ranges, span)
+            ]
+            if not eligible:
                 return None
-            self._worker_rr = (self._worker_rr + 1) % (len(self._workers) + 1)
+            self._worker_rr = (self._worker_rr + 1) % (len(eligible) + 1)
             if self._worker_rr == 0:
                 return None
-            return self._workers[self._worker_rr - 1]
+            return eligible[self._worker_rr - 1]
 
     def _drop_worker(self, client):
         with self._lock:
-            if client in self._workers:
-                self._workers.remove(client)
+            self._workers = [
+                (c, r) for c, r in self._workers if c is not client
+            ]
             self._worker_strikes.pop(client, None)
         client.close()
 
     WORKER_STRIKE_LIMIT = 3
+    WORKER_REFRESH_MIN_S = 1.0
 
     def _worker_ok(self, client):
         with self._lock:
@@ -563,6 +595,19 @@ class RemoteCluster:
             self._worker_strikes[client] = n
         if n >= self.WORKER_STRIKE_LIMIT:
             self._drop_worker(client)
+            # A struck-out worker may be healthy with a STALE coverage
+            # map on our side: a DD move makes its ownership backstop
+            # answer 1009 for spans we still think it serves. Re-snapshot
+            # the registry (throttled) so workers rejoin with fresh
+            # ranges instead of staying evicted for the session.
+            now = time.monotonic()
+            if now - getattr(self, "_last_worker_refresh", 0.0) \
+                    >= self.WORKER_REFRESH_MIN_S:
+                self._last_worker_refresh = now
+                try:
+                    self.refresh_workers()
+                except (ConnectionLost, OSError):
+                    pass  # lead unreachable: reads already fall back
 
     def connection_string(self):
         return ",".join(self.addresses)
@@ -579,5 +624,5 @@ class RemoteCluster:
                 self._client.close()
                 self._client = None
             workers, self._workers = self._workers, []
-        for c in workers:
+        for c, _ in workers:
             c.close()

@@ -41,6 +41,17 @@ SYSTEM_END = b"\xff\xff"
 WORKER_HOLD_TTL_S = 30.0  # a hold not refreshed this long is abandoned
 
 
+def _intersect_ranges(a, b):
+    """Intersection of two merged [begin, end) range lists."""
+    out = []
+    for ab, ae in a:
+        for bb, be in b:
+            lo, hi = max(ab, bb), min(ae, be)
+            if lo < hi:
+                out.append((lo, hi))
+    return sorted(out)
+
+
 class LogFeed:
     """Lead-side endpoints a worker pulls from (attach to the lead's
     RpcServer next to the ClusterService handlers)."""
@@ -58,7 +69,15 @@ class LogFeed:
             "tlog_release": self.tlog_release,
             "worker_register": self.worker_register,
             "list_workers": self.list_workers,
+            "tag_ranges": self.tag_ranges,
         }
+
+    def tag_ranges(self, tag):
+        """The key ranges storage tag ``tag`` covers — a tag-scoped
+        worker bootstraps exactly these (ref: a storage's keyServers
+        subscription)."""
+        return [tuple(r) for r in
+                self.cluster.storage_owned_ranges(int(tag))]
 
     def _prune_stale(self):
         now = time.monotonic()
@@ -84,22 +103,29 @@ class LogFeed:
         with self._lock:
             self._holds.pop(name, None)
 
-    def tlog_peek(self, from_version, limit=512, wait_s=0.0):
+    def tlog_peek(self, from_version, limit=512, wait_s=0.0, tag=None):
         """With ``wait_s``: park on the log's push condition until a
         record newer than from_version exists or the wait expires — a
         tailing worker long-polls instead of hammering 500 peek RPCs/s
         at an idle lead, and the parked thread costs zero CPU (the push
-        path signals it). Served from the blocking pool."""
+        path signals it). Served from the blocking pool.
+
+        ``tag``: serve only that storage tag's stream — a tag-scoped
+        worker pulls its shards' bytes, not the whole firehose (ref:
+        TLog tag cursors)."""
         self._prune_stale()
         if wait_s and self.cluster.tlog.last_version <= from_version:
             self.cluster.tlog.wait_for_version(
                 from_version + 1, timeout=min(wait_s, 5.0)
             )
-        recs = self.cluster.tlog.peek(from_version)
+        recs = self.cluster.tlog.peek(from_version, tag=tag)
         # floor travels WITH the records: a gap (records popped below the
         # floor before this worker applied them) must be detectable even
-        # on a reply that carries newer records
+        # on a reply that carries newer records. The shard-map epoch
+        # rides along too, so a tagged worker learns of ownership moves
+        # from its next peek instead of polling the map.
         return (self.cluster.tlog._first_version,
+                getattr(self.cluster, "shard_epoch", 0),
                 [(v, list(muts)) for v, muts in recs[:limit]])
 
     def tlog_floor(self):
@@ -111,20 +137,24 @@ class LogFeed:
     # registry: who serves reads (clients discover via list_workers)
     _workers = None
 
-    def worker_register(self, address):
+    def worker_register(self, address, ranges=None):
+        """``ranges``: the key ranges this worker serves (None = the
+        whole keyspace); clients route reads by coverage."""
         with self._lock:
             if self._workers is None:
                 self._workers = {}
-            self._workers[address] = time.monotonic()
-        TraceEvent("StorageWorkerJoined").detail(address=address).log()
+            self._workers[address] = (time.monotonic(), ranges)
+        TraceEvent("StorageWorkerJoined").detail(
+            address=address, tagged=ranges is not None).log()
 
     def list_workers(self):
+        """[(address, ranges-or-None), ...] of live workers."""
         with self._lock:
             if not self._workers:
                 return []
             now = time.monotonic()
             return [
-                a for a, ts in self._workers.items()
+                (a, rg) for a, (ts, rg) in self._workers.items()
                 if now - ts < WORKER_HOLD_TTL_S * 10
             ]
 
@@ -140,13 +170,25 @@ class StorageWorker:
     _ids = itertools.count(1)
 
     def __init__(self, lead_address, window_versions=5_000_000,
-                 chunk=1000, name=None, secret=None):
+                 chunk=1000, name=None, secret=None, tag=None):
         import os
 
         from foundationdb_tpu.server.storage import StorageServer
 
         self.lead_address = lead_address
         self.secret = secret
+        # tag = a storage id: this worker subscribes to THAT tag's log
+        # stream and bootstraps/serves only its owned ranges (ref: a
+        # storage server peeking its own tag). None = full keyspace.
+        self.tag = tag
+        self.ranges = None  # fetched at bootstrap when tagged
+        # what READS may be served: swapped atomically with the store
+        # (self.ranges can run ahead during a re-bootstrap; serving
+        # against it would expose moved-in shards before their data
+        # arrives). None = full keyspace; [] = nothing yet.
+        self._served_ranges = None if tag is None else []
+        self._seen_epoch = -1
+        self.bytes_pulled = 0
         # pid-qualified: two --join PROCESSES must never share a hold
         # name, or the faster one advances the cursor past the slower
         # one's position and the pump pops records it still needs
@@ -208,20 +250,26 @@ class StorageWorker:
         # not pop anything the tail will need, no matter how the grab
         # and the pump interleave
         self._call("tlog_hold", self.name, 0)
+        if self.tag is not None:
+            self.ranges = [tuple(r) for r in
+                           self._call("tag_ranges", self.tag)]
         for attempt in range(attempts):
             rv = self._call("get_read_version")
             self._call("tlog_hold", self.name, rv)
             fresh = StorageServer(window_versions=self.window_versions)
-            begin = b""
+            spans = self.ranges or [(b"", SYSTEM_END)]
             muts = []
             try:
-                while True:
-                    rows = self._call("get_range", begin, SYSTEM_END, rv,
-                                      self.chunk, False)
-                    muts.extend(Mutation(Op.SET, k, v) for k, v in rows)
-                    if len(rows) < self.chunk:
-                        break
-                    begin = key_successor(rows[-1][0])
+                for span_b, span_e in spans:
+                    begin = span_b
+                    while True:
+                        rows = self._call("get_range", begin,
+                                          min(span_e, SYSTEM_END), rv,
+                                          self.chunk, False)
+                        muts.extend(Mutation(Op.SET, k, v) for k, v in rows)
+                        if len(rows) < self.chunk:
+                            break
+                        begin = key_successor(rows[-1][0])
             except FDBError as e:
                 if e.code == 1007 and attempt + 1 < attempts:
                     continue  # snapshot fell out of the window: re-pin
@@ -229,6 +277,7 @@ class StorageWorker:
             if rv > 0:
                 fresh.apply(rv, muts)
             self.storage = fresh  # atomic swap; readers see the new cut
+            self._served_ranges = self.ranges  # now backed by the store
             self.position = rv
             self._last_refresh = time.monotonic()
             TraceEvent("StorageWorkerBootstrapped").detail(
@@ -237,8 +286,36 @@ class StorageWorker:
 
     def _tail_once(self):
         # long-poll: the lead blocks (cheap) until records exist, so an
-        # idle worker costs ~4 RPCs/s, not 500
-        floor, recs = self._call("tlog_peek", self.position, 512, 0.25)
+        # idle worker costs ~4 RPCs/s, not 500. A tagged worker pulls
+        # only its tag's stream (~its owned fraction of the bytes).
+        floor, epoch, recs = self._call(
+            "tlog_peek", self.position, 512, 0.25, self.tag
+        )
+        self.bytes_pulled += sum(
+            len(m.key) + len(m.param or b"")
+            for _, muts in recs for m in muts
+        )
+        if self.tag is not None and epoch != self._seen_epoch:
+            # The shard map changed: DD moves copy data storage-to-
+            # storage, NOT through this tag's stream, so moved-in
+            # shards are missing locally. Shrink serving to the
+            # still-owned intersection IMMEDIATELY (moved-away spans
+            # must stop serving pre-move values), then re-bootstrap
+            # onto the full new coverage (ref: fetchKeys on a
+            # relocated shard). Reads routed here during the at-most-
+            # one-peek-interval detection window may see pre-move
+            # state — the same bounded metadata-propagation window the
+            # reference closes with versioned shard ownership.
+            self._seen_epoch = epoch
+            fresh = [tuple(r) for r in self._call("tag_ranges", self.tag)]
+            if fresh != self.ranges:
+                TraceEvent("StorageWorkerRangesMoved").detail(
+                    name=self.name, tag=self.tag).log()
+                self._served_ranges = _intersect_ranges(
+                    self._served_ranges or [], fresh
+                )
+                self._bootstrap()
+                return
         if floor > self.position:
             # GAP: records in (position, floor] were popped before we
             # applied them (our hold aged out, or we were reborn) —
@@ -262,7 +339,7 @@ class StorageWorker:
             # commits flowed for a while
             self._call("tlog_hold", self.name, self.position)
             if self._advertise is not None:
-                self._call("worker_register", self._advertise)
+                self._call("worker_register", self._advertise, self.ranges)
             self._last_refresh = now
 
     def wait_caught_up(self, timeout=30.0):
@@ -284,16 +361,34 @@ class StorageWorker:
                 raise err("future_version")
             time.sleep(0.0005)
 
+    def _check_cover(self, span):
+        """Authoritative ownership check: a tagged worker serves only
+        what its CURRENT store covers (clients route by a coverage map
+        they snapshot at connect; after a DD move that map is stale and
+        this is the backstop that turns a mis-routed read into a
+        retryable 1009 — served from the lead — instead of a silently
+        stale value)."""
+        served = self._served_ranges
+        if served is None:
+            return
+        if span is None or not any(
+            rb <= span[0] and span[1] <= re_ for rb, re_ in served
+        ):
+            raise err("future_version")
+
     def storage_get(self, key, rv):
+        self._check_cover((key, key + b"\x00"))
         return self._wait_version(rv).get(key, rv)
 
     def get_range(self, begin, end, rv, limit, reverse):
+        self._check_cover((begin, end))
         rows = self._wait_version(rv).get_range(
             begin, end, rv, limit=limit, reverse=reverse
         )
         return [(k, v) for k, v in rows]
 
     def resolve_selector(self, selector, rv):
+        self._check_cover(None)  # selectors walk: full coverage only
         return self._wait_version(rv).resolve_selector(selector, rv)
 
     def worker_status(self):
@@ -302,6 +397,8 @@ class StorageWorker:
             "version": self.storage.version,
             "position": self.position,
             "caught_up": self._caught_up.is_set(),
+            "tag": self.tag,
+            "bytes_pulled": self.bytes_pulled,
         }
 
     def handlers(self):
@@ -320,7 +417,7 @@ class StorageWorker:
             secret=self.secret,
         )
         self._advertise = server.address  # tail ticks re-register us
-        self._call("worker_register", server.address)
+        self._call("worker_register", server.address, self.ranges)
         return server
 
     def close(self):

@@ -80,6 +80,7 @@ class BatchingCommitProxy:
         self.txns_batched = 0
         self.max_batch_seen = 0
         self.last_batch_error = None
+        self._backlog_target = self.MAX_BACKLOG
         self._thread = None
         if mode == "thread":
             self._thread = threading.Thread(
@@ -140,13 +141,35 @@ class BatchingCommitProxy:
     # host-side packing memory bounded), not the dispatch width.
     MAX_BACKLOG = 64
 
+    # Conflict-adaptive backlog depth: every txn in one settle round
+    # resolves against read versions from before the round, so OCC
+    # conflict probability grows with depth × contention. On contended
+    # workloads (TPC-C hot rows) a 64-deep backlog turns throughput into
+    # retries; on YCSB-shaped traffic depth is pure win. AIMD on the
+    # observed conflict rate — the same signal the reference's
+    # ratekeeper damps overload with (ref: Ratekeeper.actor.cpp).
+    BACKLOG_SHRINK_AT = 0.35  # conflict rate that halves the depth
+    BACKLOG_GROW_AT = 0.15  # conflict rate that lets depth double
+
+    def _adapt_backlog(self, txns, conflicts):
+        if txns == 0:
+            return
+        rate = conflicts / txns
+        if rate > self.BACKLOG_SHRINK_AT:
+            self._backlog_target = max(1, self._backlog_target // 2)
+        elif rate < self.BACKLOG_GROW_AT:
+            self._backlog_target = min(
+                self.MAX_BACKLOG, self._backlog_target * 2
+            )
+
     def _run_batch(self, pending):
         chunks = [
             pending[i : i + self.max_batch]
             for i in range(0, len(pending), self.max_batch)
         ]
         while chunks:
-            group, chunks = chunks[: self.MAX_BACKLOG], chunks[self.MAX_BACKLOG:]
+            depth = self._backlog_target
+            group, chunks = chunks[:depth], chunks[depth:]
             if len(group) > 1 and hasattr(self.inner, "commit_batches"):
                 # a backlog: one resolver dispatch covers every chunk
                 # (ref: the proxy pipelining resolution across batches)
@@ -157,8 +180,15 @@ class BatchingCommitProxy:
                 except Exception as e:
                     self._fail_chunks(group, e)
                     continue
+                txns = conflicts = 0
                 for chunk, results in zip(group, results_list):
                     self._settle(chunk, results)
+                    txns += len(results)
+                    conflicts += sum(
+                        1 for r in results
+                        if isinstance(r, FDBError) and r.code == 1020
+                    )
+                self._adapt_backlog(txns, conflicts)
                 continue
             for chunk in group:
                 try:
@@ -173,6 +203,11 @@ class BatchingCommitProxy:
                     self._fail_chunks([chunk], e)
                     continue
                 self._settle(chunk, results)
+                self._adapt_backlog(
+                    len(results),
+                    sum(1 for r in results
+                        if isinstance(r, FDBError) and r.code == 1020),
+                )
 
     def _settle(self, chunk, results):
         self.batches_committed += 1

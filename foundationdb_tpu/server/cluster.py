@@ -314,11 +314,17 @@ class Cluster:
         replicas (ref: NativeAPI getKeyLocation + LoadBalance)."""
         return self.router
 
+    # monotone shard-map epoch: bumped on every rebalance so tag-scoped
+    # storage workers learn of ownership moves from peek replies instead
+    # of polling the map (rpc/storageworker.py)
+    shard_epoch = 0
+
     def rebalance(self):
         """One data-distribution round (splits/merges/moves), then
         persist the new map in the system keyspace and re-derive the
         resolver key ranges from it."""
         moves = self.dd.rebalance()
+        self.shard_epoch += 1
         self.persist_shard_map()
         self.commit_proxy.update_resolver_ranges()
         return moves
@@ -345,6 +351,30 @@ class Cluster:
 
     def storage_drained(self, sid):
         return self.dd.storage_owns_nothing(sid)
+
+    def storage_owned_ranges(self, sid):
+        """The key ranges storage ``sid``'s tag covers (merged, plus the
+        everywhere-replicated system keyspace) — what a tag-scoped
+        storage worker bootstraps and serves (ref: the keyServers
+        ranges a storage's tag subscribes it to)."""
+        end_cap = b"\xff\xff"
+        if self.replication >= len(self.storages):
+            return [(b"", end_cap)]
+        smap = self.dd.map
+        owned = []
+        for i in range(len(smap)):
+            if sid in smap.teams[i]:
+                b, e = smap.shard_range(i)
+                owned.append((b, e if e is not None else b"\xff"))
+        owned.sort()
+        merged = []
+        for b, e in owned:
+            if merged and b <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([b, e])
+        merged.append([b"\xff", end_cap])  # system keyspace: everywhere
+        return [tuple(r) for r in merged]
 
     def estimated_range_size_bytes(self, begin, end):
         """Ref: fdb_transaction_get_estimated_range_size_bytes — the

@@ -227,9 +227,18 @@ class CommitProxy:
                         m.key, len(m.key) + len(m.param or b"")
                     )
 
+        # Route BEFORE the push so the log stores the per-tag split
+        # (ref: applyMetadataToCommittedTransactions tagging mutations
+        # with storage tags, TLogServer's per-tag streams): storage
+        # workers then peek only their own stream. Full replication
+        # skips tags — every tag's stream IS the full batch.
+        routed = self._route(batch_mutations)
+        tags = None
+        if self.dd is not None and self.dd.replication < len(self.storages):
+            tags = dict(enumerate(routed))
         # push even empty batches so storage's version advances with cv
         try:
-            self.tlog.push(cv, batch_mutations)
+            self.tlog.push(cv, batch_mutations, tags=tags)
         except TLogDown:
             # no durability quorum: the would-be-committed txns are in
             # limbo → honest 1021, nothing applied to storage (ref:
@@ -244,7 +253,7 @@ class CommitProxy:
                 else FDBError.from_name("commit_unknown_result")
                 for r in results
             ]
-        for sid, muts in enumerate(self._route(batch_mutations)):
+        for sid, muts in enumerate(routed):
             if not self.storages[sid].alive:
                 # a detected-dead storage misses the batch; recruitment
                 # replaces it wholesale (re-ingest from live teammates),

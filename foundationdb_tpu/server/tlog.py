@@ -5,6 +5,16 @@ version-ordered mutation batches; storage servers peek from their durable
 version and pop when applied. Durability here is an optional append-only
 file WAL with length-framed records (the reference fsyncs a DiskQueue).
 
+TAG PARTITIONING (ref: tag streams in TLogServer.actor.cpp +
+TagPartitionedLogSystem.actor.cpp): the commit proxy routes each
+mutation to its owning storages (tags) before the push and hands the
+log the per-tag split; ``peek(from_version, tag=...)`` then serves ONE
+storage's stream — a worker that owns 1/k of the keyspace pulls ~1/k of
+the bytes instead of the whole firehose. Tags live in memory alongside
+the records (the WAL keeps the untagged batch: recovery re-routes by
+the restored shard map, and a tag-less recovered record legally serves
+the full batch to every cursor — conservative, never lossy).
+
 ``TLogSystem`` is the replicated tier (ref: TagPartitionedLogSystem):
 k TLog replicas, a push is acked once a quorum made it durable, peeks
 merge across live replicas, and recovery unions the surviving WALs —
@@ -26,6 +36,7 @@ class TLogDown(Exception):
 class TLog:
     def __init__(self, wal_path=None, fsync=False):
         self._log = []  # list[(version, mutations)]
+        self._tags = {}  # version -> {tag: [mutations]} (memory only)
         self._first_version = 0
         self.wal_path = wal_path
         self.fsync = fsync
@@ -52,12 +63,17 @@ class TLog:
         if self.fsync:
             os.fsync(self._wal.fileno())
 
-    def push(self, version, mutations):
+    def push(self, version, mutations, tags=None):
+        """``tags``: optional {tag: [mutations]} split of this batch by
+        destination storage (the proxy's routing); enables per-tag
+        peeks. The WAL stores the untagged batch only."""
         if not self.alive:
             raise TLogDown()
         if self._log and version <= self._log[-1][0]:
             raise ValueError("tlog push out of order")
         self._log.append((version, mutations))
+        if tags is not None:
+            self._tags[version] = tags
         self._wal_append((version, mutations))
         with self._data_cond:
             self._data_cond.notify_all()
@@ -92,12 +108,19 @@ class TLog:
             raise TLogDown()
         if self._log and self._log[-1][0] == version:
             self._log.pop()
+            self._tags.pop(version, None)
             self._wal_append(("abort", version))
 
-    def peek(self, from_version):
+    def peek(self, from_version, tag=None):
         """All records with version > from_version, in order. The log
         is version-sorted, so this bisects to the start instead of
-        filtering the whole retained window (storage workers poll)."""
+        filtering the whole retained window (storage workers poll).
+
+        With ``tag``: each record carries only that tag's mutations (the
+        per-storage stream — ref: TLog tag cursors). Every version still
+        appears (possibly empty) so cursors advance; records pushed
+        without tags (recovered WALs) serve the full batch —
+        conservative, never lossy."""
         if not self.alive:
             raise TLogDown()
         # snapshot once: pop() swaps the list on the commit thread, and a
@@ -105,7 +128,14 @@ class TLog:
         # one would silently skip still-retained records
         log = self._log
         i = bisect.bisect_right(log, from_version, key=lambda r: r[0])
-        return log[i:]
+        recs = log[i:]
+        if tag is None:
+            return recs
+        tags = self._tags
+        return [
+            (v, tags[v].get(tag, []) if v in tags else m)
+            for v, m in recs
+        ]
 
     def hold_pop(self, name, version):
         """Register a peek cursor: records newer than ``version`` survive
@@ -126,6 +156,10 @@ class TLog:
         if holds:
             up_to_version = min(up_to_version, *holds)
         self._log = [(v, m) for v, m in self._log if v > up_to_version]
+        if self._tags:
+            self._tags = {
+                v: t for v, t in self._tags.items() if v > up_to_version
+            }
         self._first_version = max(self._first_version, up_to_version)
 
     @property
@@ -219,9 +253,10 @@ class TLogSystem:
             return None
         log.alive = True
         log._log = []
+        log._tags = {}
         log._first_version = donor._first_version
         for v, m in donor.peek(0):
-            log.push(v, m)
+            log.push(v, m, tags=donor._tags.get(v))
         return log
 
     @property
@@ -238,7 +273,7 @@ class TLogSystem:
         for l in self.logs:
             l._first_version = v
 
-    def push(self, version, mutations):
+    def push(self, version, mutations, tags=None):
         """Replicate to every live log; durable at ``quorum`` acks.
         Raises TLogDown when a quorum is unreachable — the partial
         replicas roll the record back (abort-marked in their WALs) so it
@@ -247,7 +282,7 @@ class TLogSystem:
         accepted = []
         for log in self.logs:
             try:
-                log.push(version, mutations)
+                log.push(version, mutations, tags=tags)
                 accepted.append(log)
             except TLogDown:
                 continue
@@ -273,7 +308,7 @@ class TLogSystem:
                 timeout=timeout,
             )
 
-    def peek(self, from_version):
+    def peek(self, from_version, tag=None):
         """Merged view across live replicas: the union of their records
         (any acked record is on ≥ quorum of them; a dead replica's gaps
         are covered by the others)."""
@@ -281,7 +316,7 @@ class TLogSystem:
         for log in self.logs:
             if not log.alive:
                 continue
-            for v, m in log.peek(from_version):
+            for v, m in log.peek(from_version, tag=tag):
                 merged.setdefault(v, m)
         return sorted(merged.items())
 

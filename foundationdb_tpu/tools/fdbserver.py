@@ -78,6 +78,10 @@ def main(argv=None):
                    help="run as a storage-worker process: pull the "
                         "mutation stream from the lead server at this "
                         "address and serve versioned reads")
+    p.add_argument("--tag", type=int, default=None,
+                   help="with --join: subscribe to ONE storage tag's "
+                        "log stream and serve only its owned ranges "
+                        "(tag-partitioned log; default: full stream)")
     p.add_argument("--storage", type=int, default=1)
     p.add_argument("--resolvers", type=int, default=1)
     p.add_argument("--tlogs", type=int, default=1)
@@ -113,7 +117,8 @@ def main(argv=None):
         # process's update loop pulling its tag from the TLogs)
         from foundationdb_tpu.rpc.storageworker import StorageWorker
 
-        worker = StorageWorker(args.join, secret=secret).start()
+        worker = StorageWorker(args.join, secret=secret,
+                               tag=args.tag).start()
         worker.wait_caught_up()
         server = worker.serve(host or "127.0.0.1", int(port))
         stop = threading.Event()

@@ -334,3 +334,39 @@ def test_backlog_dispatches_through_commit_batches():
         assert len(versions) == 3
     finally:
         cluster.close()
+
+
+def test_backlog_depth_adapts_to_conflict_rate():
+    """AIMD on observed conflicts: a contended workload shrinks the
+    backlog depth (deep pipelines of stale read versions explode OCC
+    retries); a clean workload grows it back."""
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.server.batcher import BatchingCommitProxy
+
+    class FakeInner:
+        knobs = type("K", (), {"batch_txn_capacity": 4,
+                               "commit_batch_interval_s": 0})()
+        conflict = True
+
+        def commit_batch(self, reqs):
+            e = FDBError(1020)
+            return [e if self.conflict else 1 for _ in reqs]
+
+        def commit_batches(self, batches):
+            return [self.commit_batch(r) for r in batches]
+
+    inner = FakeInner()
+    bp = BatchingCommitProxy(inner, max_batch=1, mode="manual")
+    assert bp._backlog_target == bp.MAX_BACKLOG
+    pending = [(object(), __import__(
+        "foundationdb_tpu.server.batcher", fromlist=["CommitFuture"]
+    ).CommitFuture()) for _ in range(bp.MAX_BACKLOG)]
+    bp._run_batch(list(pending))
+    assert bp._backlog_target == bp.MAX_BACKLOG // 2  # conflicts halve it
+    for _ in range(10):
+        bp._run_batch(list(pending))
+    assert bp._backlog_target == 1  # keeps shrinking under contention
+    inner.conflict = False
+    for _ in range(10):
+        bp._run_batch(list(pending))
+    assert bp._backlog_target == bp.MAX_BACKLOG  # clean traffic regrows

@@ -503,3 +503,41 @@ def test_resolve_many_chunks_oversized_backlog():
     # the 3-batch remainder chunk may legitimately ride resolve() when
     # small, but the two full chunks must NOT have fallen back per-batch
     assert resolved["n"] <= 3
+
+
+def test_pallas_scan_matches_jnp_scan():
+    """keep_pallas=True keeps the Pallas ring inside lax.scan (the
+    range-mode throughput path): statuses must be bit-identical to the
+    jnp-lane scan on mixed workloads (interpret mode off-TPU)."""
+    import jax
+
+    rng = random.Random(13)
+    version = 100
+    batches = []
+    for _ in range(6):
+        txns = []
+        for _ in range(rng.randrange(2, SMALL.txns + 1)):
+            t = rand_txn(rng, 25, version - rng.randrange(0, 20))
+            if rng.random() < 0.5:
+                a, b = sorted([b"k%04d" % rng.randrange(25),
+                               b"k%04d" % rng.randrange(25)])
+                t.range_writes.append((a, b + b"\xff"))
+            if rng.random() < 0.5:
+                a, b = sorted([b"k%04d" % rng.randrange(25),
+                               b"k%04d" % rng.randrange(25)])
+                t.range_reads.append((a, b + b"\xff"))
+            txns.append(t)
+        version += rng.randrange(1, 8)
+        batches.append((txns, version, max(0, version - 50)))
+
+    def run_scan(keep_pallas):
+        params = SMALL._replace(use_pallas=True)
+        packer = BatchPacker(params)
+        packed = [packer.pack(t, 0, cv, ws) for t, cv, ws in batches]
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *packed)
+        scan = ck.make_resolve_scan_fn(params, donate=False,
+                                       keep_pallas=keep_pallas)
+        _, st = scan(ck.init_state(params), stacked)
+        return np.asarray(st).tolist()
+
+    assert run_scan(True) == run_scan(False)
