@@ -19,6 +19,7 @@ _ERRORS = {
     1037: "process_behind",
     1038: "database_locked",
     1101: "operation_cancelled",
+    1213: "tag_throttled",
     2000: "client_invalid_operation",
     2002: "commit_read_incomplete",
     2003: "test_specification_invalid",
@@ -42,7 +43,7 @@ _BY_NAME = {v: k for k, v in _ERRORS.items()}
 
 # Errors on which the standard retry loop (Transaction.on_error) retries.
 # Ref: fdb_error_predicate(FDB_ERROR_PREDICATE_RETRYABLE, ...) in bindings/c.
-RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037})
+RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1213})
 MAYBE_COMMITTED = frozenset({1021})
 
 

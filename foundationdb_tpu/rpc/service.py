@@ -143,8 +143,10 @@ class ClusterService:
     def status(self):
         return self.cluster.status()
 
-    def get_read_version(self):
-        return self.cluster.grv_proxy.get_read_version()
+    def get_read_version(self, priority="default", tags=()):
+        return self.cluster.grv_proxy.get_read_version(
+            priority, tags=tuple(tags)
+        )
 
     def storage_get(self, key, rv):
         return self.cluster.read_storage(key).get(key, rv)
@@ -322,8 +324,8 @@ class _RemoteGrvProxy:
     def __init__(self, rc):
         self._rc = rc
 
-    def get_read_version(self):
-        return self._rc._call("get_read_version")
+    def get_read_version(self, priority="default", tags=()):
+        return self._rc._call("get_read_version", priority, tuple(tags))
 
 
 class _RemoteCommitProxy:
@@ -543,7 +545,14 @@ class RemoteCluster:
             old, self._workers = self._workers, clients
             for c, _ in old:
                 self._worker_strikes.pop(c, None)
-        for c, _ in old:
+            # retire rather than close: a concurrent reader may be
+            # mid-call on an old client — closing now would abort a
+            # healthy read. Retired clients close on the NEXT refresh
+            # (in-flight calls are long finished by then) or at close().
+            retiring, self._retired_workers = (
+                getattr(self, "_retired_workers", []), [c for c, _ in old]
+            )
+        for c in retiring:
             c.close()
         return addresses
 
@@ -624,5 +633,9 @@ class RemoteCluster:
                 self._client.close()
                 self._client = None
             workers, self._workers = self._workers, []
+            retired = getattr(self, "_retired_workers", [])
+            self._retired_workers = []
         for c, _ in workers:
+            c.close()
+        for c in retired:
             c.close()

@@ -532,6 +532,8 @@ class Cluster:
                         rk.target_tps * rk.batch_priority_fraction
                     ),
                     "throttled_count": rk.throttled_count,
+                    "throttled_tags": rk.throttled_tags(),
+                    "tag_throttled_count": rk.tag_throttled_count,
                 },
                 "workload": {
                     "transactions": {

@@ -25,9 +25,15 @@ class GrvProxy:
         self.ratekeeper = ratekeeper
         self.grv_count = 0
 
-    def get_read_version(self, priority="default"):
-        if self.ratekeeper is not None and not self.ratekeeper.admit(priority):
-            raise err("process_behind")  # client backs off and retries
+    def get_read_version(self, priority="default", tags=()):
+        if self.ratekeeper is not None:
+            ok, reason = self.ratekeeper.admit_with_reason(priority, tags)
+            if not ok:
+                # tag-throttled (1213) vs cluster-saturated (1037): both
+                # retryable, but the client (and its operator) should
+                # know WHICH gate closed (ref: GrvProxyTagThrottler)
+                raise err("tag_throttled" if reason == "tag"
+                          else "process_behind")
         self.grv_count += 1
         return self.sequencer.committed_version
 
@@ -59,11 +65,18 @@ class BatchingGrvProxy:
     def __getattr__(self, name):  # grv_count, sequencer, ... pass through
         return getattr(self.inner, name)
 
-    def get_read_version(self, priority="default"):
+    def get_read_version(self, priority="default", tags=()):
         if priority == "immediate":
             with self._lock:  # counter consistency with the grant loop
                 return self.inner.get_read_version(priority)  # bypass
         rk = self.inner.ratekeeper
+        if rk is not None and tags and not rk.tag_gate(tags):
+            # tag gates close immediately (1213, retryable) rather than
+            # queueing: a throttled tag's requests must not occupy the
+            # shared FIFO ahead of well-behaved traffic (ref: the
+            # per-tag queues in GrvProxyTagThrottler); the global
+            # budget is charged by the grant loop as usual
+            raise err("tag_throttled")
         qkey = "batch" if priority == "batch" else "default"
         with self._lock:
             if (

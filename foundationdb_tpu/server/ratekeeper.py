@@ -26,6 +26,12 @@ class Ratekeeper:
     LAG_HARD = 4_000_000  # window leaves ~1s headroom before TOO_OLD pain)
     CONFLICT_TRIM = 0.5  # conflict ratio above which the budget is trimmed
     FLOOR_FRACTION = 0.01
+    # ── per-tag auto-throttling (ref: fdbserver/TagThrottler.actor.cpp,
+    # GrvProxyTagThrottler.actor.cpp: busy tags get their own rate limit
+    # so one abusive workload cannot starve the rest) ──
+    TAG_SAMPLE_MIN = 64  # admissions before a tag can auto-throttle
+    TAG_BUSY_FRACTION = 0.5  # share of admissions that reads as "busy"
+    TAG_RELEASE_FACTOR = 1.5  # limit regrowth per healthy control round
 
     def __init__(self, target_tps=1e9, batch_priority_fraction=0.5, clock=None):
         self.max_tps = target_tps
@@ -40,31 +46,109 @@ class Ratekeeper:
         self._recent_txns = 0
         self._recent_conflicts = 0
         self.throttled_count = 0  # GRV requests rejected at the gate
+        # per-tag state: sampled admissions per control window, manual
+        # quotas (operator), auto limits (control loop), token buckets
+        self._tag_counts = {}  # tag -> admissions this window
+        self._recent_admits = 0  # all admissions this window (share base)
+        self._tag_window_start = self.clock()
+        self.tag_quotas = {}  # tag -> tps (manual, sticky)
+        self.tag_limits = {}  # tag -> tps (auto, AIMD)
+        self._tag_buckets = {}  # tag -> [tokens, last_refill]
+        self.tag_throttled_count = 0
         # thread-mode clusters admit from many client threads while the
         # batcher thread feeds observe_commit/update: the token bucket's
         # read-modify-write must not interleave
         self._mu = threading.Lock()
 
     # ── GRV-edge enforcement (ref: GrvProxy transaction budgets) ──
-    def admit(self, priority="default"):
+    def admit(self, priority="default", tags=()):
+        ok, _ = self.admit_with_reason(priority, tags)
+        return ok
+
+    def admit_with_reason(self, priority="default", tags=()):
+        """→ (admitted, None | "tag" | "budget"). Tag buckets are
+        checked before the global bucket so a throttled tag's denial
+        never burns global tokens; admissions (not attempts) feed the
+        busy-tag sample, or a throttled-but-retrying tag could never
+        observe a rate low enough to be released."""
         if priority == "immediate":
-            return True  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
+            return True, None  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
+        with self._mu:
+            now = self.clock()
+            ok, limited = self._tags_check_locked(tags, now)
+            if not ok:
+                return False, "tag"
+            if not self._global_pass_locked(priority, now):
+                # tag buckets deliberately NOT charged on a global deny:
+                # a tagged client retrying 1037 under saturation must
+                # not drain its quota with zero admissions
+                return False, "budget"
+            for b in limited:
+                b[0] -= 1.0
+            self._note_admit_locked(tags)
+            return True, None
+
+    def tag_gate(self, tags):
+        """The tag half alone (BatchingGrvProxy closes tag gates before
+        queueing so a throttled tag never occupies the shared FIFO; the
+        global budget is charged later by the grant loop). Both the tag
+        count and the admissions base are sampled here — the grant
+        loop's untagged admit() adds to the base again, so tagged share
+        is under- (never over-) estimated for batching deployments,
+        biasing AWAY from spurious auto-throttling."""
+        if not tags:
+            return True
+        with self._mu:
+            now = self.clock()
+            ok, limited = self._tags_check_locked(tags, now)
+            if not ok:
+                return False
+            for b in limited:
+                b[0] -= 1.0
+            self._note_admit_locked(tags)
+            return True
+
+    def _tags_check_locked(self, tags, now):
+        """All-or-nothing check → (ok, limited_buckets): the CALLER
+        charges the returned buckets only once the whole admission
+        passes (a multi-tag txn denied by its second tag — or by the
+        global budget — must not burn any tag's token)."""
+        limited = []
+        for tag in tags:
+            limit = self.tag_quotas.get(tag, self.tag_limits.get(tag))
+            if limit is None:
+                continue
+            b = self._tag_buckets.get(tag)
+            if b is None:
+                b = self._tag_buckets[tag] = [limit, now]
+            b[0] = min(limit, b[0] + (now - b[1]) * limit)
+            b[1] = now
+            if b[0] < 1.0:
+                self.tag_throttled_count += 1
+                return False, []
+            limited.append(b)
+        return True, limited
+
+    def _global_pass_locked(self, priority, now):
         need = 1.0
         if priority == "batch":
             # batch priority only runs when spare capacity exists
             need = 1.0 / max(self.batch_priority_fraction, 1e-6)
-        with self._mu:
-            now = self.clock()
-            self._tokens = min(
-                self.target_tps,
-                self._tokens + (now - self._last_refill) * self.target_tps,
-            )
-            self._last_refill = now
-            if self._tokens >= need:
-                self._tokens -= need
-                return True
-            self.throttled_count += 1
-            return False
+        self._tokens = min(
+            self.target_tps,
+            self._tokens + (now - self._last_refill) * self.target_tps,
+        )
+        self._last_refill = now
+        if self._tokens >= need:
+            self._tokens -= need
+            return True
+        self.throttled_count += 1
+        return False
+
+    def _note_admit_locked(self, tags):
+        self._recent_admits += 1
+        for tag in tags:
+            self._tag_counts[tag] = self._tag_counts.get(tag, 0) + 1
 
     def observe_commit(self, txns, conflicts):
         """Both arguments are per-batch increments."""
@@ -119,7 +203,69 @@ class Ratekeeper:
             # recover at most 10% per round so oscillation damps out
             target = min(target, max(self.target_tps * 1.1, floor))
         self.target_tps = max(floor, target)
+        self._update_tags_locked()
         return self.target_tps
+
+    def _update_tags_locked(self):
+        """Busy-tag auto-throttling (ref: TagThrottler::autoThrottleTag):
+        while the cluster is shedding load, a tag responsible for more
+        than TAG_BUSY_FRACTION of admissions gets its own limit at half
+        its observed rate (multiplicative decrease); healthy rounds
+        regrow the limit until it clears the tag's demand, then release
+        it. Manual quotas (tag_quotas) are operator-sticky and never
+        auto-released."""
+        now = self.clock()
+        elapsed = max(now - self._tag_window_start, 1e-9)
+        total = self._recent_admits
+        under_pressure = self.target_tps < self.max_tps * 0.9
+        # visit limited-but-silent tags too: a tag that stopped sending
+        # must have its limit regrown/released, not kept forever
+        for tag in set(self._tag_counts) | set(self.tag_limits):
+            cnt = self._tag_counts.get(tag, 0)
+            rate = cnt / elapsed
+            busy = (
+                cnt >= self.TAG_SAMPLE_MIN
+                and total > 0
+                and cnt / total > self.TAG_BUSY_FRACTION
+            )
+            limit = self.tag_limits.get(tag)
+            if under_pressure and busy:
+                new_limit = max(rate / 2, 1.0)
+                self.tag_limits[tag] = (
+                    min(limit, new_limit) if limit is not None else new_limit
+                )
+            elif limit is not None and not under_pressure:
+                grown = limit * self.TAG_RELEASE_FACTOR
+                if grown > rate * 2:
+                    del self.tag_limits[tag]
+                    self._tag_buckets.pop(tag, None)
+                else:
+                    self.tag_limits[tag] = grown
+        # drop buckets for stale released tags; reset the sample window
+        for tag in list(self._tag_buckets):
+            if tag not in self.tag_limits and tag not in self.tag_quotas:
+                del self._tag_buckets[tag]
+        self._tag_counts = {}
+        self._recent_admits = 0
+        self._tag_window_start = now
+
+    def set_tag_quota(self, tag, tps):
+        """Operator-set per-tag rate limit (ref: the tag quota system);
+        ``tps=None`` clears it."""
+        with self._mu:
+            if tps is None:
+                self.tag_quotas.pop(tag, None)
+                if tag not in self.tag_limits:
+                    self._tag_buckets.pop(tag, None)
+            else:
+                self.tag_quotas[tag] = float(tps)
+
+    def throttled_tags(self):
+        """Snapshot for status json: tag -> effective tps limit."""
+        with self._mu:
+            out = dict(self.tag_limits)
+            out.update(self.tag_quotas)
+            return out
 
     def set_target_tps(self, tps):
         self.max_tps = float(tps)

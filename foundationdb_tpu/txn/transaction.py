@@ -59,6 +59,23 @@ class TransactionOptions:
         """Ref: LOCK_AWARE — commit even while the database is locked."""
         self._tr._lock_aware = True
 
+    def set_tag(self, tag):
+        """Attach a transaction tag for per-tag throttling (ref:
+        TAG/AUTO_THROTTLE_TAG options + TagThrottler): the ratekeeper
+        samples per-tag load and can rate-limit a busy tag (error 1213,
+        retryable) without touching other traffic. At most 5 tags of
+        ≤16 bytes each (the reference's limits)."""
+        if isinstance(tag, bytes):
+            # latin-1 is byte-bijective: distinct binary tags stay
+            # distinct throttle buckets (utf-8/replace would collide)
+            tag = tag.decode("latin-1")
+        if len(tag.encode("latin-1", "replace")) > 16:
+            raise err("invalid_option_value")
+        if tag not in self._tr._tags:
+            if len(self._tr._tags) >= 5:
+                raise err("invalid_option_value")
+            self._tr._tags.append(tag)
+
     def set_retry_limit(self, n):
         self._tr._retry_limit = int(n)
 
@@ -128,6 +145,7 @@ class Transaction:
         self._next_write_no_conflict = False
         self._report_conflicting_keys = False
         self._lock_aware = False
+        self._tags = []  # transaction tags (per-tag throttling)
         self._retry_limit = None
         self._max_retry_delay = self.db._knobs.max_retry_delay_s
         self._timeout_s = None
@@ -143,7 +161,11 @@ class Transaction:
     # ─────────────────────────── versions ─────────────────────────────
     def get_read_version(self):
         if self._read_version is None:
-            self._read_version = self._cluster.grv_proxy.get_read_version()
+            grv = self._cluster.grv_proxy
+            self._read_version = (
+                grv.get_read_version(tags=tuple(self._tags))
+                if self._tags else grv.get_read_version()
+            )
         return self._read_version
 
     def set_read_version(self, version):
