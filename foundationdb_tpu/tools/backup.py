@@ -20,6 +20,7 @@ or an operator loop pumps it.
 import json
 import os
 
+from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.mutations import Mutation, Op
 
 
@@ -29,6 +30,27 @@ def _enc(b):
 
 def _dec(s):
     return s.encode("latin-1")
+
+
+def _scan_snapshot_to_file(tr, path, chunk):
+    """Paginated consistent range dump at ``tr``'s read version (the
+    one snapshot scan both agents share)."""
+    with open(path, "w") as f:
+        begin = b""
+        while True:
+            rows = tr.get_range(begin, b"\xff", limit=chunk, snapshot=True)
+            for k, val in rows:
+                f.write(json.dumps({"k": _enc(k), "v": _enc(val)}) + "\n")
+            if len(rows) < chunk:
+                break
+            begin = rows[-1][0] + b"\x00"
+
+
+def _atomic_json_write(path, obj):
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 class BackupAgent:
@@ -58,15 +80,7 @@ class BackupAgent:
         self.db._cluster.tlog.hold_pop(f"backup@{id(self)}", v)
         path = os.path.join(self.dir, f"snapshot-{v}.jsonl")
         try:
-            with open(path, "w") as f:
-                begin = b""
-                while True:
-                    rows = tr.get_range(begin, b"\xff", limit=chunk, snapshot=True)
-                    for k, val in rows:
-                        f.write(json.dumps({"k": _enc(k), "v": _enc(val)}) + "\n")
-                    if len(rows) < chunk:
-                        break
-                    begin = rows[-1][0] + b"\x00"
+            _scan_snapshot_to_file(tr, path, chunk)
         except BaseException:
             # a failed scan (TOO_OLD on a huge keyspace, IO error) must not
             # leave the tlog pinned at v forever
@@ -112,15 +126,187 @@ class BackupAgent:
         return self._log_through
 
     def _write_manifest(self):
-        manifest = {
+        _atomic_json_write(os.path.join(self.dir, "restorable.json"), {
             "snapshot_version": self.snapshot_version,
             "log_from": self._log_from,
             "log_through": self._log_through,
-        }
-        tmp = os.path.join(self.dir, "restorable.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(self.dir, "restorable.json"))
+        })
+
+
+BACKUP_STATE_PREFIX = b"\xff/backup/"
+
+
+class ContinuousBackupAgent:
+    """A continuously running incremental backup (ref:
+    fdbclient/FileBackupAgent.actor.cpp + BackupAgentBase: the agent
+    persists its progress in the system keyspace, writes incremental
+    mutation-log files forever, and any version within retention is
+    restorable).
+
+    Shape here:
+    - ``start()`` registers a change feed over the user keyspace, cuts
+      the base snapshot, and persists agent state under
+      ``\\xff/backup/<name>/`` through ordinary transactions (tlog-
+      durable, recovered like user data). No tlog pin: the FEED buffers
+      post-registration mutations, which is the reference's
+      backup-worker position in the pipeline.
+    - ``tick()`` (pumped by an operator loop or the simulation) drains
+      the feed into a ``log-<from>-<to>.jsonl`` chunk file, pops the
+      feed (the consumer checkpoint), and advances the persisted
+      ``log_through`` — restore can then target ANY version in
+      [snapshot_version, log_through].
+    - a trimmed feed (1007: the agent fell behind retention) or a feed
+      lost to cluster recovery re-bases loudly: new feed + new
+      snapshot, continuity restarts (ref: the agent re-snapshotting
+      when it cannot guarantee log continuity).
+    - ``resume(db, name)`` reopens a running agent from its persisted
+      system-keyspace state after an agent-process crash.
+    """
+
+    FEED_RANGE = (b"", b"\xff")
+
+    def __init__(self, db, backup_dir, name="default"):
+        self.db = db
+        self.dir = backup_dir
+        self.name = name
+        self.feed_id = f"backup/{name}"
+        self.snapshot_version = None
+        self.log_through = None
+        self.chunks = []  # [(from_v, to_v, filename)]
+        self.rebased = 0  # times continuity restarted (trim/recovery)
+        os.makedirs(backup_dir, exist_ok=True)
+
+    # ── system-keyspace state (ref: the backup config keyspace) ──
+    def _state_key(self, field):
+        return BACKUP_STATE_PREFIX + self.name.encode() + b"/" + field
+
+    def _persist(self, **fields):
+        def _apply(tr):
+            for k, v in fields.items():
+                tr.set(self._state_key(k.encode()), str(v).encode())
+
+        self.db.run(_apply)
+
+    @classmethod
+    def load_state(cls, db, name="default"):
+        """The persisted agent state (None when no agent ever ran)."""
+        prefix = BACKUP_STATE_PREFIX + name.encode() + b"/"
+
+        def _read(tr):
+            return {
+                k[len(prefix):].decode(): v.decode()
+                for k, v in tr.get_range(prefix, prefix + b"\xff")
+            }
+
+        state = db.run(_read)
+        return state or None
+
+    @classmethod
+    def resume(cls, db, backup_dir, name="default"):
+        """Reopen from persisted state (agent-process restart)."""
+        state = cls.load_state(db, name)
+        if state is None or state.get("state") != "running":
+            raise RuntimeError(f"no running backup agent {name!r}")
+        agent = cls(db, backup_dir, name)
+        agent.snapshot_version = int(state["snapshot_version"])
+        agent.log_through = int(state["log_through"])
+        m = describe_backup(backup_dir)
+        agent.chunks = [tuple(c) for c in m.get("chunks", [])]
+        return agent
+
+    # ── lifecycle ──
+    def start(self):
+        feeds = self.db._cluster.change_feeds
+        try:
+            feeds.register(self.feed_id, *self.FEED_RANGE)
+        except FDBError:
+            # stale feed from a prior agent incarnation: restart it so
+            # the pop frontier cannot hide pre-snapshot history
+            feeds.deregister(self.feed_id)
+            feeds.register(self.feed_id, *self.FEED_RANGE)
+        try:
+            v = self._cut_snapshot()
+        except BaseException:
+            # a failed snapshot must not leave a FRESH feed paired with
+            # stale persisted state: a retried tick() would read the new
+            # feed from the old cursor without error and silently skip
+            # everything between the trim and this registration
+            feeds.deregister(self.feed_id)
+            raise
+        self.snapshot_version = v
+        self.log_through = v
+        self.chunks = []
+        self._persist(state="running", snapshot_version=v, log_through=v)
+        self._write_manifest()
+        return v
+
+    def _cut_snapshot(self, chunk=1000):
+        tr = self.db.create_transaction()
+        v = tr.get_read_version()
+        path = os.path.join(self.dir, f"snapshot-{v}.jsonl")
+        _scan_snapshot_to_file(tr, path, chunk)
+        return v
+
+    def tick(self):
+        """One agent round: drain the feed → an incremental chunk file,
+        checkpoint, persist progress. Returns log_through."""
+        feeds = self.db._cluster.change_feeds
+        try:
+            entries = feeds.read(self.feed_id, self.log_through)
+        except FDBError as e:
+            # 1007: trimmed past our checkpoint (agent fell behind) —
+            # continuity is broken, re-base. 2000: the feed died with a
+            # cluster recovery — same treatment.
+            from foundationdb_tpu.utils.trace import TraceEvent
+
+            TraceEvent("BackupAgentRebase", severity=30).detail(
+                name=self.name, error=e.code).log()
+            self.rebased += 1
+            self.start()
+            return self.log_through
+        if not entries:
+            return self.log_through
+        first, last = entries[0][0], entries[-1][0]
+        fname = f"log-{first}-{last}.jsonl"
+        with open(os.path.join(self.dir, fname), "w") as f:
+            for version, muts in entries:
+                f.write(json.dumps({
+                    "v": version,
+                    "muts": [
+                        [m.op.value, _enc(m.key),
+                         _enc(m.param) if m.param is not None else None]
+                        for m in muts
+                    ],
+                }) + "\n")
+        # Crash-ordering: manifest + persisted cursor FIRST, feed pop
+        # LAST (the reference pops only after the consumer checkpoint is
+        # durable). A crash before the pop re-reads overlapping entries
+        # next tick — restore() dedupes by version, so overlap is safe;
+        # popping first would instead 1007 the resumed agent into a
+        # spurious full re-base.
+        if (first, last, fname) not in self.chunks:
+            self.chunks.append((first, last, fname))
+        self.log_through = last
+        self._write_manifest()
+        self._persist(log_through=last)
+        feeds.pop(self.feed_id, last)
+        return last
+
+    def stop(self):
+        try:
+            self.db._cluster.change_feeds.deregister(self.feed_id)
+        except FDBError:
+            pass
+        self._persist(state="stopped")
+
+    def _write_manifest(self):
+        _atomic_json_write(os.path.join(self.dir, "restorable.json"), {
+            "snapshot_version": self.snapshot_version,
+            "log_from": self.snapshot_version,
+            "log_through": self.log_through,
+            "chunks": self.chunks,
+            "continuous": True,
+        })
 
 
 def describe_backup(backup_dir):
@@ -129,13 +315,16 @@ def describe_backup(backup_dir):
         return json.load(f)
 
 
-def restore(db, backup_dir, target_version=None, prefix=b""):
+def restore(db, backup_dir, target_version=None, prefix=b"", ranges=None):
     """Restore a backup into ``db`` (ref: fdbrestore / performRestore).
 
     Loads the snapshot, then replays logged mutations with version ≤
     ``target_version`` (default: everything), all through normal
     transactions so the restored data is itself durable/replicated.
-    Returns the version the restore reached.
+    ``ranges``: restrict the restore to these [begin, end) key ranges
+    (ref: fdbrestore's -k range restore) — snapshot rows outside them
+    are skipped and logged mutations are clipped. Returns the version
+    the restore reached.
     """
     manifest = describe_backup(backup_dir)
     sv = manifest["snapshot_version"]
@@ -145,6 +334,9 @@ def restore(db, backup_dir, target_version=None, prefix=b""):
         raise ValueError(
             f"target_version {target_version} predates snapshot {sv}"
         )
+
+    def in_ranges(key):
+        return ranges is None or any(b <= key < e for b, e in ranges)
 
     snap_path = os.path.join(backup_dir, f"snapshot-{sv}.jsonl")
     batch = []
@@ -159,27 +351,54 @@ def restore(db, backup_dir, target_version=None, prefix=b""):
     with open(snap_path) as f:
         for line in f:
             row = json.loads(line)
-            batch.append((_dec(row["k"]), _dec(row["v"])))
+            key = _dec(row["k"])
+            if not in_ranges(key):
+                continue
+            batch.append((key, _dec(row["v"])))
             if len(batch) >= 500:
                 flush(batch)
                 batch = []
     if batch:
         flush(batch)
 
-    log_path = os.path.join(backup_dir, "log.jsonl")
-    if os.path.exists(log_path):
+    # mutation-log sources: the continuous agent's chunk files (in
+    # order), or the legacy single log.jsonl
+    log_paths = [
+        os.path.join(backup_dir, fname)
+        for _, _, fname in sorted(manifest.get("chunks", []))
+    ]
+    legacy = os.path.join(backup_dir, "log.jsonl")
+    if os.path.exists(legacy):
+        log_paths.append(legacy)
+    replayed_through = sv  # versions ≤ this are already applied: chunks
+    # may overlap after a crash between chunk write and feed pop, and
+    # atomic ops must replay each version exactly once
+    for log_path in log_paths:
         with open(log_path) as f:
             for line in f:
                 rec = json.loads(line)
-                if rec["v"] <= sv or rec["v"] > target_version:
+                if rec["v"] <= replayed_through or rec["v"] > target_version:
                     continue
+                replayed_through = rec["v"]
                 muts = []
                 for op, k, p in rec["muts"]:
                     op = Op(op)
+                    key = _dec(k)
                     param = _dec(p) if p is not None else None
                     if op == Op.CLEAR_RANGE and param is not None:
+                        if ranges is not None:
+                            # clip the clear to each restored range
+                            for rb, re_ in ranges:
+                                cb, ce = max(key, rb), min(param, re_)
+                                if cb < ce:
+                                    muts.append(Mutation(
+                                        op, prefix + cb, prefix + ce
+                                    ))
+                            continue
                         param = prefix + param  # the param is the end KEY
-                    muts.append(Mutation(op, prefix + _dec(k), param))
+                    elif not in_ranges(key):
+                        continue
+                    muts.append(Mutation(op, prefix + key, param))
                 _replay(db, muts)
     return target_version
 
