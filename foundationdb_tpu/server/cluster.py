@@ -102,20 +102,7 @@ class Cluster:
         # Generation lock is a CAS: read g, commit g+1 expecting g — two
         # concurrent recoveries cannot both win the slot (the loser sees
         # GenerationConflict, re-reads, and bids for the next slot).
-        for _ in range(10):
-            prior = self.coordination.read_quorum() or {}
-            self.generation = prior.get("generation", 0) + 1
-            try:
-                self.coordination.write_quorum(
-                    {"generation": self.generation,
-                     "recovered_version": recovered},
-                    expect_generation=self.generation - 1,
-                )
-                break
-            except GenerationConflict:
-                continue
-        else:
-            raise CoordinatorDown("could not win a recovery generation")
+        self.generation = self._win_generation(recovered)
         TraceEvent("MasterRecovered").detail(
             generation=self.generation, version=recovered).log()
 
@@ -216,6 +203,23 @@ class Cluster:
                 self.grv_proxy, interval_s=knobs.grv_batch_interval_s,
             )
 
+    def _win_generation(self, recovered):
+        """CAS a new recovery generation at the coordinators: read g,
+        commit g+1 expecting g — two concurrent recoveries cannot both
+        win a slot (the loser re-reads and bids for the next one)."""
+        for _ in range(10):
+            prior = self.coordination.read_quorum() or {}
+            gen = prior.get("generation", 0) + 1
+            try:
+                self.coordination.write_quorum(
+                    {"generation": gen, "recovered_version": recovered},
+                    expect_generation=gen - 1,
+                )
+                return gen
+            except GenerationConflict:
+                continue
+        raise CoordinatorDown("could not win a recovery generation")
+
     # ── failure detection + recruitment ──────────────────────────────
     # Ref: fdbserver/ClusterController.actor.cpp failureDetectionServer +
     # workerAvailabilityWatch: the controller notices dead role instances
@@ -228,6 +232,14 @@ class Cluster:
         """One failure-monitor round; returns [(role, index), ...] of
         recruitments performed."""
         events = []
+        if not self.sequencer.alive or not self._commit_target().alive:
+            # a dead sequencer or commit proxy forces a transaction-
+            # system recovery: new generation through the coordination
+            # CAS, resolvers fenced, fresh sequencer/proxies — WITHOUT
+            # touching storage or the logs (ref: ClusterRecovery
+            # recruiting a new txn-system generation)
+            self._recover_txn_system()
+            events.append(("txn-system", 0))
         if isinstance(self.tlog, TLogSystem):
             for i, log in enumerate(self.tlog.logs):
                 if not log.alive and self.tlog.revive(i) is not None:
@@ -253,6 +265,66 @@ class Cluster:
             self.recruitments += len(events)
             TraceEvent("RolesRecruited").detail(events=events).log()
         return events
+
+    def _recover_txn_system(self):
+        """The recovery state machine for dead sequencer/commit-proxy
+        roles (ref: fdbserver/ClusterRecovery.actor.cpp): win a new
+        generation at the coordinators (CAS), restart the version
+        authority above everything the log acked, fence the resolvers
+        (their windows open at the recovery version, so pre-death read
+        versions retry TOO_OLD), and recruit fresh proxies over the
+        SAME storages/logs — data is not torn down or re-ingested."""
+        recovered = max(
+            self.tlog.last_version, self.sequencer.committed_version
+        )
+        gen = self.generation = self._win_generation(recovered)
+        self.sequencer = Sequencer(
+            version_clock=self.sequencer.version_clock,
+            start_version=recovered,
+        )
+        # fence conflict history: in-flight txns retry with fresh reads
+        for i, r in enumerate(self.resolvers):
+            self.resolvers[i] = r.respawn(recovered)
+        old_proxy = self.commit_proxy
+        old_target = self._commit_target()
+        inner = CommitProxy(
+            self.sequencer, self.resolvers, self.tlog, self.storages,
+            self.knobs, self.ratekeeper, dd=self.dd,
+            change_feeds=self.change_feeds,
+        )
+        # the database lock is cluster state, not proxy state: survive
+        # the recovery (ref: lock state living in the system keyspace)
+        if getattr(old_target, "lock_uid", None) is not None:
+            inner.lock_uid = old_target.lock_uid
+        inner.update_resolver_ranges(fence=False)
+        new_proxy = inner
+        if self.commit_pipeline != "sync":
+            from foundationdb_tpu.server.batcher import BatchingCommitProxy
+
+            new_proxy = BatchingCommitProxy(
+                inner, max_batch=old_proxy.max_batch,
+                interval_s=old_proxy.interval_s,
+                flush_after=old_proxy.flush_after,
+                mode=self.commit_pipeline,
+            )
+        self.commit_proxy = new_proxy
+        if self.commit_pipeline != "sync":
+            # queued commits raced the death: resolve them 1021 so
+            # their clients retry against the new generation
+            old_proxy.fail_pending(err("commit_unknown_result"))
+        old_proxy.close()
+        old_grv = self.grv_proxy
+        self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
+        if self.commit_pipeline == "thread":
+            from foundationdb_tpu.server.grv import BatchingGrvProxy
+
+            self.grv_proxy = BatchingGrvProxy(
+                self.grv_proxy, interval_s=self.knobs.grv_batch_interval_s,
+            )
+        if hasattr(old_grv, "close"):
+            old_grv.close()
+        TraceEvent("TxnSystemRecovered").detail(
+            generation=gen, version=recovered).log()
 
     def _recruit_storage(self, sid):
         """Replace a dead storage by rebooting onto its durable engine
@@ -546,6 +618,8 @@ class Cluster:
                 "oldest_readable_version": self.storage.oldest_version,
                 "commit_pipeline": self.commit_pipeline,
                 "processes": {
+                    "sequencer": {"alive": self.sequencer.alive},
+                    "commit_proxy": {"alive": self._commit_target().alive},
                     "resolvers": [
                         {"id": i, "alive": r.alive,
                          "backend": self.knobs.resolver_backend,

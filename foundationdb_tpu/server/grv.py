@@ -26,6 +26,11 @@ class GrvProxy:
         self.grv_count = 0
 
     def get_read_version(self, priority="default", tags=()):
+        if not getattr(self.sequencer, "alive", True):
+            # version authority dead: stall GRVs retryably until the
+            # failure monitor recruits a new generation (ref: GRVs
+            # blocking through a master recovery)
+            raise err("process_behind")
         if self.ratekeeper is not None:
             ok, reason = self.ratekeeper.admit_with_reason(priority, tags)
             if not ok:
@@ -66,6 +71,11 @@ class BatchingGrvProxy:
         return getattr(self.inner, name)
 
     def get_read_version(self, priority="default", tags=()):
+        if not getattr(self.inner.sequencer, "alive", True):
+            # dead version authority: stall retryably (1037) — the fast
+            # path and grant loop read committed_version directly, so
+            # the liveness check must happen here too
+            raise err("process_behind")
         if priority == "immediate":
             with self._lock:  # counter consistency with the grant loop
                 return self.inner.get_read_version(priority)  # bypass
@@ -136,6 +146,19 @@ class BatchingGrvProxy:
                         for p in ("default", "batch")}
                 self._queues = {"default": [], "batch": []}
             rk = self.inner.ratekeeper
+            if not getattr(self.inner.sequencer, "alive", True):
+                # the sequencer died with requests queued: fail them
+                # retryably rather than granting a dead authority's
+                # frozen version
+                with self._lock:
+                    n = 0
+                    for qkey in ("default", "batch"):
+                        for fut in work[qkey]:
+                            fut["error"] = err("process_behind")
+                            fut["event"].set()
+                            n += 1
+                    self._pending -= n
+                continue
             version = None  # ONE committed-version read per grant round
             granted_any = False
             round_granted = 0

@@ -22,6 +22,7 @@ from foundationdb_tpu.server.tlog import TLogDown
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None):
+        self.alive = True
         self.sequencer = sequencer
         self.resolvers = resolvers  # list; key-range sharded when >1
         self.tlog = tlog
@@ -97,6 +98,16 @@ class CommitProxy:
         """
         if not requests:
             return []
+        if not self.alive or not self.sequencer.alive:
+            # the proxy (or the version authority behind it) is dead:
+            # honest 1021 — a request may have been in flight when the
+            # process died; clients retry and the failure monitor
+            # recruits a new transaction-system generation (ref: proxy
+            # death surfacing as broken connections → 1021)
+            return [
+                FDBError.from_name("commit_unknown_result")
+                for _ in requests
+            ]
         with self._commit_mu:
             return self._commit_batch_locked(requests)
 
@@ -137,7 +148,8 @@ class CommitProxy:
         in order. Semantically identical to sequential commit_batch calls
         — this is the throughput path when commits outrun the link to
         the chip (ref: the proxy pipelining resolution across batches)."""
-        if len(self.resolvers) != 1:
+        if (len(self.resolvers) != 1 or not self.alive
+                or not self.sequencer.alive):
             return [self.commit_batch(reqs) for reqs in request_batches]
         with self._commit_mu:
             if getattr(self, "lock_uid", None) is not None:
@@ -394,6 +406,11 @@ class CommitProxy:
             else:
                 out.append(CONFLICT)
         return out
+
+    def kill(self):
+        """Process death: every commit answers 1021 until the failure
+        monitor recruits a new transaction-system generation."""
+        self.alive = False
 
     def close(self):
         """Release the sub-resolve thread pool (simulation rebuilds the

@@ -11,18 +11,31 @@ import time
 from foundationdb_tpu.core.versions import VERSIONS_PER_SECOND
 
 
+class SequencerDown(Exception):
+    """The version authority is dead; GRVs and commits fail retryably
+    until the failure monitor recruits a new transaction system."""
+
+
 class Sequencer:
     def __init__(self, version_clock="counter", start_version=0):
         assert version_clock in ("counter", "wall")
         self.version_clock = version_clock
+        self.alive = True
         self._committed = start_version
         self._last_granted = start_version
         self._epoch = time.monotonic()
         self._start = start_version
 
+    def kill(self):
+        """Master death (ref: master failure forcing a full recovery —
+        a new sequencer generation must fence this one's versions)."""
+        self.alive = False
+
     def next_commit_version(self, min_advance=1000):
         """Grant the next batch's commit version (ref: the proxy's
         getVersion request; one version per commit batch)."""
+        if not self.alive:
+            raise SequencerDown()
         if self.version_clock == "wall":
             wall = self._start + int((time.monotonic() - self._epoch) * VERSIONS_PER_SECOND)
             v = max(self._last_granted + min_advance, wall)

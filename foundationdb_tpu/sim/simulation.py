@@ -264,8 +264,30 @@ class Simulation:
             if live:
                 c.resolvers[self.rng.choice(live)].kill()
                 self.role_kills += 1
+        # txn-system kills: a dead sequencer/proxy forces a full
+        # recovery generation (resolvers fenced, storage untouched);
+        # clients see 1021/1037 until the monitor's next round
+        if self.buggify("proxy_kill", fire_p=0.0015):
+            target = c._commit_target()
+            if target.alive:
+                target.kill()
+                self.role_kills += 1
+        if self.buggify("sequencer_kill", fire_p=0.001):
+            if c.sequencer.alive:
+                c.sequencer.kill()
+                self.role_kills += 1
         if self.steps % self.MONITOR_EVERY == 0:
-            c.detect_and_recruit()
+            events = c.detect_and_recruit()
+            if any(role == "txn-system" for role, _ in events):
+                # recovery recruited bare proxies: restore the sim's
+                # fault-injection wrappers around the new incarnation
+                # (and re-cache the manual-mode pump — the old one
+                # would pump a dead batcher, stalling queued commits)
+                c.commit_proxy = FaultyCommitProxy(
+                    c.commit_proxy, self.buggify
+                )
+                c.grv_proxy = FaultyGrvProxy(c.grv_proxy, self.buggify)
+                self._pump = getattr(c.commit_proxy, "pump", None)
 
     def _storage_killable(self, sid):
         """Every shard sid owns must keep one other live owner."""

@@ -280,10 +280,11 @@ class ContinuousBackupAgent:
                 }) + "\n")
         # Crash-ordering: manifest + persisted cursor FIRST, feed pop
         # LAST (the reference pops only after the consumer checkpoint is
-        # durable). A crash before the pop re-reads overlapping entries
-        # next tick — restore() dedupes by version, so overlap is safe;
-        # popping first would instead 1007 the resumed agent into a
-        # spurious full re-base.
+        # durable). A crash between the manifest and the cursor persist
+        # resumes with an older cursor and re-chunks entries the
+        # manifest already references — restore() dedupes by version,
+        # so overlap is safe; popping first would instead 1007 the
+        # resumed agent into a spurious full re-base.
         if (first, last, fname) not in self.chunks:
             self.chunks.append((first, last, fname))
         self.log_through = last

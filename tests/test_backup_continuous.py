@@ -199,10 +199,11 @@ def test_restore_to_range(tmp_path):
     c.close()
 
 
-def test_tick_crash_before_pop_is_safe_for_atomics(tmp_path):
+def test_tick_crash_before_cursor_persist_is_safe_for_atomics(tmp_path):
     """Crash window regression (round-3 review): a tick that durably
-    wrote its chunk but died before popping the feed re-reads
-    overlapping entries on resume; restore must replay each version
+    wrote its chunk + manifest but died before persisting the cursor
+    resumes with the OLD cursor, re-reads the same feed entries, and
+    writes an overlapping chunk; restore must replay each version
     exactly once (atomic ADDs would otherwise double-apply)."""
     c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
     db = c.database()
@@ -211,12 +212,18 @@ def test_tick_crash_before_pop_is_safe_for_atomics(tmp_path):
     agent.start()
     for i in range(6):
         db.run(lambda tr: tr.add(b"acc", (1).to_bytes(8, "little")))
-    feeds = c.change_feeds
-    real_pop = feeds.pop
-    feeds.pop = lambda *a: (_ for _ in ()).throw(RuntimeError("crash"))
+    real_persist = agent._persist
+    agent._persist = lambda **kw: (_ for _ in ()).throw(
+        RuntimeError("crash")
+    )
     with pytest.raises(RuntimeError):
-        agent.tick()  # chunk + manifest + cursor durable; pop "crashed"
-    feeds.pop = real_pop
+        agent.tick()  # chunk + manifest durable; cursor persist "crashed"
+    agent._persist = real_persist
+    # the manifest references the chunk but the DB cursor is stale
+    m0 = describe_backup(str(tmp_path / "bk"))
+    assert len(m0["chunks"]) == 1
+    state = ContinuousBackupAgent.load_state(db)
+    assert int(state["log_through"]) < m0["log_through"]
 
     resumed = ContinuousBackupAgent.resume(db, str(tmp_path / "bk"))
     db.run(lambda tr: tr.add(b"acc", (1).to_bytes(8, "little")))

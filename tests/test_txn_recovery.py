@@ -1,0 +1,213 @@
+"""Transaction-system recovery (VERDICT r2 missing #5): a dead
+sequencer or commit proxy is replaced by running the recovery state
+machine — new generation via the coordination CAS, resolvers fenced,
+storage/logs untouched — while clients ride it out with retryable
+errors (ref: fdbserver/ClusterRecovery.actor.cpp)."""
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.server.cluster import Cluster
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(resolver_backend="cpu", n_storage=2, **TEST_KNOBS)
+    yield c
+    c.close()
+
+
+def test_commit_proxy_death_recovers_without_storage_teardown(cluster):
+    db = cluster.database()
+    for i in range(10):
+        db[b"k%03d" % i] = b"v%d" % i
+    stale = db.create_transaction()
+    assert stale.get(b"k000") == b"v0"  # pin an EARLY read version
+    stale[b"k000"] = b"stale"
+    # commits after the pin: history the recovered resolver cannot
+    # check, so the stale read version must be fenced
+    for i in range(10, 20):
+        db[b"k%03d" % i] = b"v%d" % i
+    gen0 = cluster.generation
+    storages_before = list(cluster.storages)
+
+    cluster._commit_target().kill()
+    tr = db.create_transaction()
+    tr[b"during"] = b"x"
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1021 and ei.value.is_retryable
+
+    events = cluster.detect_and_recruit()
+    assert ("txn-system", 0) in events
+    assert cluster.generation > gen0  # CAS-won recovery generation
+    assert cluster.storages is not None
+    assert list(cluster.storages) == storages_before  # NOT torn down
+    assert cluster.storage.get(b"k019", cluster.storage.version) == b"v19"
+
+    # the in-flight retryable rides out via the standard loop
+    tr.on_error(ei.value)
+    tr[b"during"] = b"x"
+    tr.commit()
+    assert db[b"during"] == b"x"
+    # pre-death read versions are fenced by the fresh resolvers
+    with pytest.raises(FDBError) as ei2:
+        stale.commit()
+    assert ei2.value.code in (1007, 1020)
+    assert cluster.consistency_check() == []
+    st = cluster.status()["cluster"]
+    assert st["processes"]["commit_proxy"]["alive"]
+    assert st["generation"] == cluster.generation
+
+
+def test_sequencer_death_stalls_grvs_then_recovers(cluster):
+    db = cluster.database()
+    db[b"a"] = b"1"
+    v_before = cluster.sequencer.committed_version
+    cluster.sequencer.kill()
+    with pytest.raises(FDBError) as ei:
+        db.create_transaction().get_read_version()
+    assert ei.value.code == 1037 and ei.value.is_retryable
+    # commits also fail retryably, not with a raw exception
+    tr = db.create_transaction()
+    tr._read_version = v_before  # bypass the dead GRV
+    tr[b"b"] = b"2"
+    with pytest.raises(FDBError) as ei2:
+        tr.commit()
+    assert ei2.value.code == 1021
+
+    events = cluster.detect_and_recruit()
+    assert ("txn-system", 0) in events
+    assert cluster.sequencer.alive
+    assert cluster.sequencer.committed_version >= v_before
+    db[b"b"] = b"2"
+    assert db[b"b"] == b"2" and db[b"a"] == b"1"
+
+
+def test_thread_pipeline_queued_commits_fail_1021_and_recover():
+    c = Cluster(resolver_backend="cpu", commit_pipeline="thread",
+                **TEST_KNOBS)
+    try:
+        db = c.database()
+        db[b"seed"] = b"s"
+        c._commit_target().kill()
+        tr = db.create_transaction()
+        tr[b"x"] = b"y"
+        fut = tr.commit_async()
+        res = fut.result(timeout=10)
+        assert isinstance(res, FDBError) and res.code == 1021
+        c.detect_and_recruit()
+        db[b"after"] = b"z"  # the recruited batching pipeline works
+        assert db[b"after"] == b"z"
+        assert db[b"seed"] == b"s"
+    finally:
+        c.close()
+
+
+def test_database_lock_survives_txn_recovery(cluster):
+    db = cluster.database()
+    cluster.lock_database(b"uid-r")
+    cluster._commit_target().kill()
+    cluster.detect_and_recruit()
+    assert cluster.lock_uid() == b"uid-r"
+    tr = db.create_transaction()
+    tr[b"k"] = b"v"
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1038
+    cluster.unlock_database()
+    db[b"k"] = b"v"
+
+
+def test_workload_rides_out_proxy_death_mid_stream(cluster):
+    """The VERDICT done-check: kill the proxy mid-workload; every txn
+    eventually commits through retries; data is complete afterward."""
+    db = cluster.database()
+    for i in range(40):
+        if i == 17:
+            cluster._commit_target().kill()
+        for attempt in range(20):
+            tr = db.create_transaction()
+            try:
+                tr[b"w%03d" % i] = b"v%d" % i
+                tr.commit()
+                break
+            except FDBError as e:
+                assert e.is_retryable
+                tr.on_error(e)
+                cluster.detect_and_recruit()  # the monitor's round
+        else:
+            raise AssertionError(f"txn {i} never committed")
+    rows = db.run(lambda tr: list(tr.get_range(b"w", b"x")))
+    assert len(rows) == 40
+    assert cluster.consistency_check() == []
+
+
+def test_sim_injects_txn_system_kills():
+    """The deterministic simulation's buggify sites include proxy and
+    sequencer kills; a seeded run with boosted fire rates recovers
+    through multiple generations and keeps the workload invariant."""
+    from foundationdb_tpu.sim.simulation import Simulation
+
+    sim = Simulation(seed=1234, resolver_backend="cpu",
+                     commit_pipeline="manual", **TEST_KNOBS)
+    try:
+        # boost the new fault sites so a short run certainly fires them
+        orig = sim.buggify
+
+        def hot(name, fire_p=0.0):
+            if name in ("proxy_kill", "sequencer_kill"):
+                fire_p = min(1.0, fire_p * 40)
+            return orig(name, fire_p=fire_p)
+
+        sim.buggify = hot
+        db = sim.db
+        gen0 = sim.cluster.generation
+
+        def writer():
+            for i in range(120):
+                for _ in range(30):
+                    tr = db.create_transaction()
+                    try:
+                        tr[b"s%03d" % i] = b"v%d" % i
+                        tr.commit()
+                        break
+                    except FDBError as e:
+                        assert e.is_retryable, e
+                        tr.on_error(e)
+                        yield
+                else:
+                    raise AssertionError(f"txn {i} starved")
+                yield
+
+        sim.add_workload("writer", writer())
+        sim.run()
+        sim.quiesce()
+        assert sim.role_kills > 0
+        assert sim.cluster.generation > gen0  # at least one recovery ran
+        rows = db.run(lambda tr: list(tr.get_range(b"s", b"t")))
+        assert len(rows) == 120
+        assert sim.cluster.consistency_check() == []
+    finally:
+        sim.close()
+
+
+def test_sequencer_death_stalls_batched_grvs():
+    """Thread-pipeline regression (round-3 review): the batching GRV
+    proxy's fast path and grant loop must also observe sequencer death
+    instead of granting the dead authority's frozen version."""
+    c = Cluster(resolver_backend="cpu", commit_pipeline="thread",
+                **TEST_KNOBS)
+    try:
+        db = c.database()
+        db[b"a"] = b"1"
+        c.sequencer.kill()
+        with pytest.raises(FDBError) as ei:
+            db.create_transaction().get_read_version()
+        assert ei.value.code == 1037
+        c.detect_and_recruit()
+        assert db[b"a"] == b"1"  # fresh GRVs flow again
+    finally:
+        c.close()
