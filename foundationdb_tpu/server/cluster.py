@@ -170,38 +170,52 @@ class Cluster:
         )
         self._read_rr = itertools.count()  # round-robin read balancing
         self.router = StorageRouter(self.storages, self.dd.map, self._read_rr)
-        self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
         from foundationdb_tpu.server.changefeed import ChangeFeedRegistry
 
         self.change_feeds = ChangeFeedRegistry()
-        self.commit_proxy = CommitProxy(
-            self.sequencer, self.resolvers, self.tlog, self.storages,
-            knobs, self.ratekeeper, dd=self.dd,
-            change_feeds=self.change_feeds,
-        )
         # ── cross-client batching (ref: CommitProxyServer commitBatcher) ──
         # "thread": a daemon batcher collects concurrent commits into
         # shared-version batches (live deployments / e2e bench).
         # "manual": deterministic batching driven by the sim scheduler.
         # "sync": 1-txn batches, the degenerate pipeline.
         self.commit_pipeline = commit_pipeline
+        self._commit_batch_max = commit_batch_max
+        self._commit_flush_after = commit_flush_after
         self.recruitments = 0  # roles replaced by the failure monitor
-        if commit_pipeline != "sync":
+        self.commit_proxy, self.grv_proxy = self._wire_pipeline(
+            self._make_commit_proxy()
+        )
+
+    def _make_commit_proxy(self):
+        return CommitProxy(
+            self.sequencer, self.resolvers, self.tlog, self.storages,
+            self.knobs, self.ratekeeper, dd=self.dd,
+            change_feeds=self.change_feeds,
+        )
+
+    def _wire_pipeline(self, inner):
+        """Wrap a bare CommitProxy + fresh GrvProxy in the configured
+        pipeline (one wiring for first boot AND txn-system recovery —
+        the two incarnations must never diverge). "thread" batches GRVs
+        too (ref: GrvProxyServer's transaction-start batching); the sim
+        keeps the synchronous proxy so admission stays deterministic."""
+        proxy = inner
+        if self.commit_pipeline != "sync":
             from foundationdb_tpu.server.batcher import BatchingCommitProxy
 
-            self.commit_proxy = BatchingCommitProxy(
-                self.commit_proxy, max_batch=commit_batch_max,
-                flush_after=commit_flush_after, mode=commit_pipeline,
+            proxy = BatchingCommitProxy(
+                inner, max_batch=self._commit_batch_max,
+                flush_after=self._commit_flush_after,
+                mode=self.commit_pipeline,
             )
-        if commit_pipeline == "thread":
-            # live deployments batch GRVs too (ref: GrvProxyServer's
-            # transaction-start batching); the sim keeps the synchronous
-            # proxy so admission stays deterministic
+        grv = GrvProxy(self.sequencer, self.ratekeeper)
+        if self.commit_pipeline == "thread":
             from foundationdb_tpu.server.grv import BatchingGrvProxy
 
-            self.grv_proxy = BatchingGrvProxy(
-                self.grv_proxy, interval_s=knobs.grv_batch_interval_s,
+            grv = BatchingGrvProxy(
+                grv, interval_s=self.knobs.grv_batch_interval_s,
             )
+        return proxy, grv
 
     def _win_generation(self, recovered):
         """CAS a new recovery generation at the coordinators: read g,
@@ -274,9 +288,21 @@ class Cluster:
         (their windows open at the recovery version, so pre-death read
         versions retry TOO_OLD), and recruit fresh proxies over the
         SAME storages/logs — data is not torn down or re-ingested."""
-        recovered = max(
-            self.tlog.last_version, self.sequencer.committed_version
-        )
+        old_proxy = self.commit_proxy
+        old_target = self._commit_target()
+        # Quiesce: mark both roles dead FIRST (future batches answer
+        # 1021 at the entry check / SequencerDown guard), then take the
+        # old proxy's commit mutex — an in-flight batch that already
+        # passed the check finishes under the OLD generation before we
+        # read the log frontier, so every acked commit is covered by
+        # ``recovered`` (no acked-but-invisible writes, no overlapping
+        # version grants into the shared tlog).
+        old_target.kill()
+        self.sequencer.kill()
+        with old_target._commit_mu:
+            recovered = max(
+                self.tlog.last_version, self.sequencer.committed_version
+            )
         gen = self.generation = self._win_generation(recovered)
         self.sequencer = Sequencer(
             version_clock=self.sequencer.version_clock,
@@ -285,42 +311,19 @@ class Cluster:
         # fence conflict history: in-flight txns retry with fresh reads
         for i, r in enumerate(self.resolvers):
             self.resolvers[i] = r.respawn(recovered)
-        old_proxy = self.commit_proxy
-        old_target = self._commit_target()
-        inner = CommitProxy(
-            self.sequencer, self.resolvers, self.tlog, self.storages,
-            self.knobs, self.ratekeeper, dd=self.dd,
-            change_feeds=self.change_feeds,
-        )
+        inner = self._make_commit_proxy()
         # the database lock is cluster state, not proxy state: survive
         # the recovery (ref: lock state living in the system keyspace)
         if getattr(old_target, "lock_uid", None) is not None:
             inner.lock_uid = old_target.lock_uid
         inner.update_resolver_ranges(fence=False)
-        new_proxy = inner
-        if self.commit_pipeline != "sync":
-            from foundationdb_tpu.server.batcher import BatchingCommitProxy
-
-            new_proxy = BatchingCommitProxy(
-                inner, max_batch=old_proxy.max_batch,
-                interval_s=old_proxy.interval_s,
-                flush_after=old_proxy.flush_after,
-                mode=self.commit_pipeline,
-            )
-        self.commit_proxy = new_proxy
+        old_grv = self.grv_proxy
+        self.commit_proxy, self.grv_proxy = self._wire_pipeline(inner)
         if self.commit_pipeline != "sync":
             # queued commits raced the death: resolve them 1021 so
             # their clients retry against the new generation
             old_proxy.fail_pending(err("commit_unknown_result"))
         old_proxy.close()
-        old_grv = self.grv_proxy
-        self.grv_proxy = GrvProxy(self.sequencer, self.ratekeeper)
-        if self.commit_pipeline == "thread":
-            from foundationdb_tpu.server.grv import BatchingGrvProxy
-
-            self.grv_proxy = BatchingGrvProxy(
-                self.grv_proxy, interval_s=self.knobs.grv_batch_interval_s,
-            )
         if hasattr(old_grv, "close"):
             old_grv.close()
         TraceEvent("TxnSystemRecovered").detail(

@@ -16,6 +16,7 @@ from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
 from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
+from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
 
 
@@ -129,7 +130,15 @@ class CommitProxy:
                     for (i, _), res in zip(passing, sub):
                         results[i] = res
                 return results
-        cv = self.sequencer.next_commit_version()
+        try:
+            cv = self.sequencer.next_commit_version()
+        except SequencerDown:
+            # the kill raced past the entry check (TOCTOU): same honest
+            # 1021 — a raw exception here would strand batcher futures
+            return [
+                FDBError.from_name("commit_unknown_result")
+                for _ in requests
+            ]
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
         txns = self._build_txns(requests)
         try:
@@ -164,12 +173,18 @@ class CommitProxy:
 
     def _commit_batches_locked(self, request_batches):
         metas = []
-        for reqs in request_batches:
-            cv = self.sequencer.next_commit_version()
-            window = max(
-                0, cv - self.knobs.max_read_transaction_life_versions
-            )
-            metas.append((reqs, self._build_txns(reqs), cv, window))
+        try:
+            for reqs in request_batches:
+                cv = self.sequencer.next_commit_version()
+                window = max(
+                    0, cv - self.knobs.max_read_transaction_life_versions
+                )
+                metas.append((reqs, self._build_txns(reqs), cv, window))
+        except SequencerDown:
+            return [
+                [FDBError.from_name("commit_unknown_result") for _ in reqs]
+                for reqs in request_batches
+            ]
         try:
             statuses_list = self.resolvers[0].resolve_many(
                 [(txns, cv, window) for _, txns, cv, window in metas]

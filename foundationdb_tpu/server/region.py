@@ -1,0 +1,165 @@
+"""Two-region disaster recovery: an async satellite log + failover.
+
+Ref parity: the reference's region configuration (region blocks in
+fdbclient/DatabaseConfiguration.cpp, satellite tlog recruitment in
+masterserver/ClusterRecovery) and the fdbdr async-replication shape: a
+secondary region consumes the primary's committed stream ASYNCHRONOUSLY
+— commits never wait on the WAN — so a regional disaster loses at most
+the measured replication lag, and failover promotes the secondary to a
+full read/write cluster.
+
+Shape here:
+- ``SecondaryRegion`` owns a satellite ``TLog`` (WAL-backed) and pulls
+  the primary log's stream on ``pump()`` (the simulation's — or an
+  operator loop's — heartbeat; deterministic under the sim scheduler).
+  A pop-hold on the primary pins records until they replicate, exactly
+  like a storage worker's cursor, so the satellite never gaps.
+- ``partition()`` models the WAN failing: pumps become no-ops and the
+  lag grows (the primary keeps committing — asynchronous replication's
+  defining trade).
+- ``failover()`` promotes: a fresh ``Cluster`` recovers from the
+  satellite WAL through the ORDINARY recovery machinery (WAL replay +
+  CAS generation) — the promoted region serves everything up to the
+  replication frontier; commits past it (== the lag at disaster time)
+  are the bounded loss the async mode accepts.
+"""
+
+import os
+
+from foundationdb_tpu.core.mutations import Mutation, Op
+from foundationdb_tpu.server.tlog import TLog, TLogDown
+from foundationdb_tpu.utils.trace import TraceEvent
+
+HOLD_NAME = "dr-secondary"
+
+
+class SecondaryRegion:
+    def __init__(self, primary_cluster, wal_path):
+        self.primary = primary_cluster
+        self.wal_path = wal_path
+        os.makedirs(os.path.dirname(wal_path) or ".", exist_ok=True)
+        self.tlog = TLog(wal_path=wal_path)
+        self.position = 0  # replication frontier (last version applied)
+        self.partitioned = False
+        self.broken = False  # continuity gap detected (see pump)
+        self._dropped = False
+        # pin the primary log from the start: records must survive until
+        # the satellite has them (ref: satellite tlogs holding the
+        # primary's mutation stream)
+        self.primary.tlog.hold_pop(HOLD_NAME, self.position)
+        self._seed()
+
+    def _seed(self):
+        """Base snapshot into the satellite WAL: a log-only satellite
+        attached to a primary with prior history (a recovered log's
+        floor is its recovery version) cannot reconstruct that history
+        from the log — DR starts with a full copy, then tails (ref:
+        fdbdr's initial range copy before mutation streaming). The
+        snapshot rides as ONE synthetic log record at its read version;
+        promotion replays it like any other record."""
+        db = self.primary.database()
+        tr = db.create_transaction()
+        v = tr.get_read_version()
+        muts = []
+        begin = b""
+        while True:
+            rows = tr.get_range(begin, b"\xff", limit=1000, snapshot=True)
+            muts.extend(Mutation(Op.SET, k, val) for k, val in rows)
+            if len(rows) < 1000:
+                break
+            begin = rows[-1][0] + b"\x00"
+        if v > 0:
+            self.tlog.push(v, muts)
+        self.position = v
+        self.primary.tlog.hold_pop(HOLD_NAME, v)
+
+    # ── replication (pumped) ──
+    def pump(self):
+        """Pull everything the primary has committed past our frontier.
+        Returns the number of records replicated this round."""
+        if self.partitioned or self._dropped or self.broken:
+            return 0
+        try:
+            # GAP check first: a primary that crashed and recovered
+            # comes back with a fresh log (floor = its recovery
+            # version) and our pop-hold gone — versions in
+            # (position, floor] are unobtainable, and silently tailing
+            # past them would promote a TORN database at failover.
+            # Mark broken loudly; the operator re-seeds DR.
+            if self.primary.tlog._first_version > self.position:
+                self.broken = True
+                TraceEvent("RegionReplicationGap", severity=40).detail(
+                    frontier=self.position,
+                    primary_floor=self.primary.tlog._first_version,
+                ).log()
+                return 0
+            records = self.primary.tlog.peek(self.position)
+        except TLogDown:
+            return 0  # primary log tier degraded: retry next round
+        n = 0
+        for version, muts in records:
+            if version <= self.position:
+                continue
+            self.tlog.push(version, muts)
+            self.position = version
+            n += 1
+        if n:
+            self.primary.tlog.hold_pop(HOLD_NAME, self.position)
+        return n
+
+    def lag_versions(self):
+        """How far behind the primary's committed frontier we are — the
+        bounded data loss a failover right now would accept."""
+        return max(
+            0, self.primary.sequencer.committed_version - self.position
+        )
+
+    # ── WAN fault / lifecycle ──
+    def partition(self):
+        self.partitioned = True
+        TraceEvent("RegionPartitioned", severity=30).detail(
+            frontier=self.position).log()
+
+    def heal(self):
+        self.partitioned = False
+
+    def reattach(self, new_primary):
+        """Point at a new primary incarnation (crash/recovery swapped
+        the cluster object). Gap detection on the next pump decides
+        whether continuity survived — a satellite that was fully caught
+        up resumes cleanly; one that was behind marks itself broken."""
+        self.primary = new_primary
+        if not self._dropped:
+            self.primary.tlog.hold_pop(HOLD_NAME, self.position)
+
+    def drop(self):
+        """Primary abandons DR: release the log pin (otherwise the
+        primary's log grows forever against a dead satellite)."""
+        self._dropped = True
+        try:
+            self.primary.tlog.release_pop(HOLD_NAME)
+        except TLogDown:
+            pass
+
+    # ── failover ──
+    def failover(self, **cluster_kwargs):
+        """Promote this region to a full cluster (ref: forced region
+        failover). Recovery replays the satellite WAL — the promoted
+        database is exactly the primary's state at the replication
+        frontier; the lag at disaster time is the accepted loss.
+        Returns the promoted Cluster."""
+        from foundationdb_tpu.server.cluster import Cluster
+
+        if self.broken:
+            raise RuntimeError(
+                "replication gap: this satellite lost continuity "
+                "(RegionReplicationGap) — re-seed DR before failing over"
+            )
+        self.tlog.close()  # flush the WAL handle before recovery reads it
+        lost = self.lag_versions() if not self.partitioned else None
+        promoted = Cluster(wal_path=self.wal_path, **cluster_kwargs)
+        TraceEvent("RegionFailover").detail(
+            frontier=self.position,
+            lag_at_failover=lost if lost is not None else "partitioned",
+        ).log()
+        return promoted

