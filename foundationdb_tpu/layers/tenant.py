@@ -5,7 +5,23 @@ tenant is a name mapped to a short unique prefix; transactions opened on
 a tenant see only their prefixed keyspace, with keys transparently
 translated at the API boundary. Metadata lives in the system keyspace at
 ``\\xff/tenant/map/<name>`` (value = prefix, tuple-encoded id).
+
+Round 3 adds the reference's management surface around the isolation:
+- **tenant modes** (ref: TenantMode): ``optional`` (default), ``required``
+  (non-tenant transactions may not touch user keys — 2130), ``disabled``
+  (tenant-prefixed writes rejected — 2134); enforced structurally at the
+  commit proxy by key prefix and persisted in ``\\xff/conf/tenant_mode``.
+- **tenant quotas** (ref: the tenant quota system enforced through tag
+  throttling): every tenant transaction auto-tags itself with a
+  per-tenant transaction tag, so ``set_tenant_quota`` is exactly a
+  ratekeeper tag quota — over-quota tenants see retryable 1213 while
+  other tenants run at full rate. Quotas persist in
+  ``\\xff/tenant/quota/<name>`` and are re-applied at recovery.
+- **tenant groups** (ref: tenant groups in TenantMetadata): an optional
+  label stored at ``\\xff/tenant/group/<name>`` for listing/placement.
 """
+
+import hashlib
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.keys import strinc
@@ -15,18 +31,32 @@ from foundationdb_tpu.txn.database import retry_loop
 TENANT_MAP_PREFIX = b"\xff/tenant/map/"
 TENANT_ID_KEY = b"\xff/tenant/idcounter"
 TENANT_DATA_PREFIX = b"\xfd"  # tenant content lives under \xfd<id>
+TENANT_QUOTA_PREFIX = b"\xff/tenant/quota/"
+TENANT_GROUP_PREFIX = b"\xff/tenant/group/"
+TENANT_MODE_KEY = b"\xff/conf/tenant_mode"
+TENANT_MODES = ("optional", "required", "disabled")
+
+
+def tenant_tag(name):
+    """The per-tenant transaction tag (stable, ≤16 bytes): quotas and
+    busy-tenant throttling ride the ordinary tag throttler."""
+    return "t/" + hashlib.sha256(bytes(name)).hexdigest()[:12]
 
 
 class TenantManagement:
     """Static tenant CRUD (ref: TenantAPI in fdbclient)."""
 
     @staticmethod
-    def create_tenant(db, name):
+    def create_tenant(db, name, group=None):
         name = bytes(name)
         if not name or name.startswith(b"\xff"):
             raise ValueError("tenant names must be non-empty and not start with \\xff")
 
         def txn(tr):
+            # read the mode INSIDE the create txn: the conflicting read
+            # serializes against a concurrent set_tenant_mode (no TOCTOU)
+            if (tr.get(TENANT_MODE_KEY) or b"optional") == b"disabled":
+                raise err("tenants_disabled")
             key = TENANT_MAP_PREFIX + name
             if tr.get(key) is not None:
                 raise err("tenant_already_exists")
@@ -35,6 +65,8 @@ class TenantManagement:
             tr.set(TENANT_ID_KEY, (tid + 1).to_bytes(8, "big"))
             prefix = TENANT_DATA_PREFIX + fdbtuple.pack((tid,))
             tr.set(key, prefix)
+            if group is not None:
+                tr.set(TENANT_GROUP_PREFIX + name, bytes(group))
             return prefix
 
         return db.run(txn)
@@ -51,8 +83,11 @@ class TenantManagement:
             if tr.get_range(prefix, strinc(prefix), limit=1):
                 raise err("tenant_not_empty")
             tr.clear(key)
+            tr.clear(TENANT_GROUP_PREFIX + name)
+            tr.clear(TENANT_QUOTA_PREFIX + name)
 
         db.run(txn)
+        db._cluster.set_tag_quota(tenant_tag(name), None)
 
     @staticmethod
     def list_tenants(db, begin=b"", end=b"\xff", limit=0):
@@ -65,6 +100,62 @@ class TenantManagement:
             ]
 
         return db.run(txn)
+
+    # ── modes (ref: TenantMode in DatabaseConfiguration) ──
+    @staticmethod
+    def set_tenant_mode(db, mode):
+        if mode not in TENANT_MODES:
+            raise err("invalid_option_value")
+
+        def txn(tr):
+            tr.set(TENANT_MODE_KEY, mode.encode())
+
+        db.run(txn)
+        db._cluster.set_tenant_mode(mode)  # live proxy enforcement
+
+    @staticmethod
+    def get_tenant_mode(db):
+        raw = db.run(lambda tr: tr.get(TENANT_MODE_KEY))
+        return raw.decode() if raw else "optional"
+
+    # ── quotas (ref: the tenant quota keyspace + tag throttling) ──
+    @staticmethod
+    def set_tenant_quota(db, name, tps):
+        """Per-tenant transaction rate limit; ``tps=None`` clears.
+        Enforced by the ratekeeper's tag throttler against the tenant's
+        auto-tag: over-quota tenant transactions see retryable 1213."""
+        name = bytes(name)
+
+        def txn(tr):
+            if tr.get(TENANT_MAP_PREFIX + name) is None:
+                raise err("tenant_not_found")
+            if tps is None:
+                tr.clear(TENANT_QUOTA_PREFIX + name)
+            else:
+                tr.set(TENANT_QUOTA_PREFIX + name, str(float(tps)).encode())
+
+        db.run(txn)
+        db._cluster.set_tag_quota(tenant_tag(name), tps)
+
+    @staticmethod
+    def get_tenant_quota(db, name):
+        raw = db.run(lambda tr: tr.get(TENANT_QUOTA_PREFIX + bytes(name)))
+        return float(raw) if raw else None
+
+    # ── groups (ref: tenant groups in TenantMetadata) ──
+    @staticmethod
+    def get_tenant_group(db, name):
+        return db.run(lambda tr: tr.get(TENANT_GROUP_PREFIX + bytes(name)))
+
+    @staticmethod
+    def list_tenant_groups(db):
+        """{group: [tenant names]} for every grouped tenant."""
+        rows = db.run(lambda tr: list(tr.get_range(
+            TENANT_GROUP_PREFIX, strinc(TENANT_GROUP_PREFIX))))
+        out = {}
+        for k, g in rows:
+            out.setdefault(g, []).append(k[len(TENANT_GROUP_PREFIX):])
+        return out
 
 
 class Tenant:
@@ -118,6 +209,9 @@ class TenantTransaction:
         self._name = name
         self._prefix = None  # resolved on first use, per txn attempt
         self.options = tr.options
+        # auto-tag: quotas and busy-tenant throttling ride the ordinary
+        # tag throttler (ref: tenant quotas enforced via tag throttling)
+        self.options.set_tag(tenant_tag(name))
 
     @property
     def _p(self):
@@ -238,10 +332,12 @@ class TenantTransaction:
     def on_error(self, e):
         self._tr.on_error(e)
         self._prefix = None  # re-resolve after reset (mapping may change)
+        self.options.set_tag(tenant_tag(self._name))  # reset drops tags
 
     def reset(self):
         self._tr.reset()
         self._prefix = None
+        self.options.set_tag(tenant_tag(self._name))
 
     def cancel(self):
         self._tr.cancel()

@@ -122,6 +122,9 @@ class ClusterService:
             "lock_database": self.cluster.lock_database,
             "unlock_database": self.cluster.unlock_database,
             "lock_uid": self.cluster.lock_uid,
+            "set_tenant_mode": self.cluster.set_tenant_mode,
+            "tenant_mode": self.cluster.tenant_mode,
+            "set_tag_quota": self.cluster.set_tag_quota,
             "feed_register": self.cluster.change_feeds.register,
             "feed_read": self.cluster.change_feeds.read,
             "feed_pop": self.cluster.change_feeds.pop,
@@ -515,6 +518,15 @@ class RemoteCluster:
 
     def lock_uid(self):
         return self._call("lock_uid")
+
+    def set_tenant_mode(self, mode):
+        return self._call("set_tenant_mode", mode)
+
+    def tenant_mode(self):
+        return self._call("tenant_mode")
+
+    def set_tag_quota(self, tag, tps):
+        return self._call("set_tag_quota", tag, tps)
 
     # ── storage-worker read balancing ──
     def refresh_workers(self):

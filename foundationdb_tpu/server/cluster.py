@@ -185,6 +185,27 @@ class Cluster:
         self.commit_proxy, self.grv_proxy = self._wire_pipeline(
             self._make_commit_proxy()
         )
+        if recovered_records:
+            self._restore_tenant_config()
+
+    def _restore_tenant_config(self):
+        """Re-apply persisted tenant mode + quotas after recovery (both
+        live in the system keyspace; enforcement is proxy/ratekeeper
+        state that died with the old process)."""
+        from foundationdb_tpu.layers.tenant import (
+            TENANT_MODE_KEY, TENANT_QUOTA_PREFIX, tenant_tag,
+        )
+
+        s0 = self.storages[0]
+        mode_row = s0.get(TENANT_MODE_KEY, s0.version)
+        if mode_row is not None:
+            self._commit_target().tenant_mode = mode_row.decode()
+        for k, v in s0.read_range(
+            TENANT_QUOTA_PREFIX, TENANT_QUOTA_PREFIX + b"\xff", s0.version
+        ):
+            self.ratekeeper.set_tag_quota(
+                tenant_tag(k[len(TENANT_QUOTA_PREFIX):]), float(v)
+            )
 
     def _make_commit_proxy(self):
         return CommitProxy(
@@ -312,10 +333,13 @@ class Cluster:
         for i, r in enumerate(self.resolvers):
             self.resolvers[i] = r.respawn(recovered)
         inner = self._make_commit_proxy()
-        # the database lock is cluster state, not proxy state: survive
-        # the recovery (ref: lock state living in the system keyspace)
+        # the database lock and tenant mode are cluster state, not proxy
+        # state: survive the recovery (ref: both living in the system
+        # keyspace)
         if getattr(old_target, "lock_uid", None) is not None:
             inner.lock_uid = old_target.lock_uid
+        if getattr(old_target, "tenant_mode", None) is not None:
+            inner.tenant_mode = old_target.tenant_mode
         inner.update_resolver_ranges(fence=False)
         old_grv = self.grv_proxy
         self.commit_proxy, self.grv_proxy = self._wire_pipeline(inner)
@@ -527,6 +551,18 @@ class Cluster:
 
     def lock_uid(self):
         return getattr(self._commit_target(), "lock_uid", None)
+
+    def set_tenant_mode(self, mode):
+        """Live enforcement switch (TenantManagement persists the system
+        row; this flips the proxy's structural check)."""
+        self._commit_target().tenant_mode = mode
+
+    def tenant_mode(self):
+        return getattr(self._commit_target(), "tenant_mode", "optional")
+
+    def set_tag_quota(self, tag, tps):
+        """Operator per-tag rate limit (tenant quotas ride this)."""
+        self.ratekeeper.set_tag_quota(tag, tps)
 
     def consistency_check(self, max_keys_per_shard=None):
         """Replica agreement audit (ref: the ConsistencyCheck workload /

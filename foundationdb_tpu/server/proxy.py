@@ -112,24 +112,72 @@ class CommitProxy:
         with self._commit_mu:
             return self._commit_batch_locked(requests)
 
+    def _partition_rejects(self, requests, reject_fn):
+        """Per-request admission gate: ``reject_fn(request)`` returns an
+        error name (rejected) or None (passing); passing requests
+        commit as a sub-batch. Returns merged results, or None when
+        nothing was rejected (caller continues with the full batch)."""
+        results = [None] * len(requests)
+        passing = []
+        for i, r in enumerate(requests):
+            bad = reject_fn(r)
+            if bad is None:
+                passing.append((i, r))
+            else:
+                results[i] = FDBError.from_name(bad)
+        if len(passing) == len(requests):
+            return None
+        if passing:
+            sub = self._commit_batch_locked([r for _, r in passing])
+            for (i, _), res in zip(passing, sub):
+                results[i] = res
+        return results
+
+    @staticmethod
+    def _tenant_mode_violation(mode, mutations):
+        """Structural tenant-mode check by KEY RANGE: tenant data lives
+        in [\xfd, \xfe), plain user data in [, \xfd) ∪ [\xfe, \xff),
+        system (mode-exempt) in [\xff, ...). CLEAR_RANGE is judged by
+        its whole [key, param) span — a range straddling the boundary
+        violates whichever space the mode forbids."""
+        for m in mutations:
+            if m.key >= b"\xff":
+                continue
+            if m.op == Op.CLEAR_RANGE:
+                b, e = m.key, min(m.param, b"\xff")
+                touches_tenant = b < b"\xfe" and e > b"\xfd"
+                touches_plain = b < b"\xfd" or e > b"\xfe"
+            else:
+                touches_tenant = m.key.startswith(b"\xfd")
+                touches_plain = not touches_tenant
+            if mode == "required" and touches_plain:
+                return "tenant_name_required"
+            if mode == "disabled" and touches_tenant:
+                return "tenants_disabled"
+        return None
+
     def _commit_batch_locked(self, requests):
         lock_uid = getattr(self, "lock_uid", None)
         if lock_uid is not None:
             # database locked (ref: lockDatabase / error 1038): only
             # lock-aware transactions pass
-            results = [None] * len(requests)
-            passing = []
-            for i, r in enumerate(requests):
-                if getattr(r, "lock_aware", False):
-                    passing.append((i, r))
-                else:
-                    results[i] = FDBError.from_name("database_locked")
-            if len(passing) < len(requests):
-                if passing:
-                    sub = self.commit_batch([r for _, r in passing])
-                    for (i, _), res in zip(passing, sub):
-                        results[i] = res
-                return results
+            out = self._partition_rejects(
+                requests,
+                lambda r: None if getattr(r, "lock_aware", False)
+                else "database_locked",
+            )
+            if out is not None:
+                return out
+        # tenant-mode enforcement (ref: TenantMode in
+        # DatabaseConfiguration) — see _tenant_mode_violation
+        mode = getattr(self, "tenant_mode", "optional")
+        if mode != "optional":
+            out = self._partition_rejects(
+                requests,
+                lambda r: self._tenant_mode_violation(mode, r.mutations),
+            )
+            if out is not None:
+                return out
         try:
             cv = self.sequencer.next_commit_version()
         except SequencerDown:

@@ -406,3 +406,162 @@ def test_nested_partition_move_to_is_parent_relative():
     assert db.run(lambda tr: moved.open(tr, "t")).raw_prefix \
         == inner.raw_prefix
     assert db.get(inner.pack((1,))) == b"row"
+
+
+# ── round-3 tenant modes / quotas / groups ──────────────────────────────
+def _tenant_db():
+    from foundationdb_tpu.server.cluster import Cluster
+
+    from conftest import TEST_KNOBS
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    return c, c.database()
+
+
+def test_tenant_modes_enforced_structurally():
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.layers.tenant import Tenant, TenantManagement
+
+    c, db = _tenant_db()
+    TenantManagement.create_tenant(db, b"acme")
+    t = Tenant(db, b"acme")
+    t[b"k"] = b"v"
+    db[b"plain"] = b"p"
+
+    TenantManagement.set_tenant_mode(db, "required")
+    assert TenantManagement.get_tenant_mode(db) == "required"
+    with pytest.raises(FDBError) as ei:
+        db[b"plain2"] = b"x"  # un-tenanted user write rejected
+    assert ei.value.code == 2130
+    t[b"k2"] = b"v2"  # tenant writes flow
+    # management/system writes are mode-exempt
+    db.run(lambda tr: tr.set(b"\xff/conf/custom", b"1"))
+
+    TenantManagement.set_tenant_mode(db, "disabled")
+    with pytest.raises(FDBError) as ei:
+        t[b"k3"] = b"v3"
+    assert ei.value.code == 2134
+    db[b"plain3"] = b"ok"  # plain writes flow again
+    with pytest.raises(FDBError):
+        TenantManagement.create_tenant(db, b"nope")
+
+    TenantManagement.set_tenant_mode(db, "optional")
+    t[b"k3"] = b"v3"
+    c.close()
+
+
+def test_tenant_mode_survives_cluster_recovery(tmp_path):
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.layers.tenant import TenantManagement
+    from foundationdb_tpu.server.cluster import Cluster
+
+    from conftest import TEST_KNOBS
+    wal = str(tmp_path / "w.wal")
+    co = str(tmp_path / "co")
+    c = Cluster(resolver_backend="cpu", wal_path=wal,
+                coordination_dir=co, **TEST_KNOBS)
+    db = c.database()
+    TenantManagement.create_tenant(db, b"t1")
+    TenantManagement.set_tenant_mode(db, "required")
+    TenantManagement.set_tenant_quota(db, b"t1", 7.0)
+    c.close()
+
+    c2 = Cluster(resolver_backend="cpu", wal_path=wal,
+                 coordination_dir=co, **TEST_KNOBS)
+    db2 = c2.database()
+    assert c2.tenant_mode() == "required"  # restored from system keyspace
+    with pytest.raises(FDBError) as ei:
+        db2[b"plain"] = b"x"
+    assert ei.value.code == 2130
+    from foundationdb_tpu.layers.tenant import tenant_tag
+    assert c2.ratekeeper.tag_quotas[tenant_tag(b"t1")] == 7.0
+    c2.close()
+
+
+def test_tenant_quota_throttles_only_that_tenant():
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.layers.tenant import Tenant, TenantManagement
+    from foundationdb_tpu.server.cluster import Cluster
+
+    from conftest import TEST_KNOBS
+
+    class FakeClock:
+        t = 0.0
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    c = Cluster(resolver_backend="cpu", target_tps=10000.0,
+                rk_clock=clock, **TEST_KNOBS)
+    db = c.database()
+    TenantManagement.create_tenant(db, b"hog")
+    TenantManagement.create_tenant(db, b"good")
+    TenantManagement.set_tenant_quota(db, b"hog", 3.0)
+    assert TenantManagement.get_tenant_quota(db, b"hog") == 3.0
+    hog, good = Tenant(db, b"hog"), Tenant(db, b"good")
+    clock.t += 1.0
+    ok = throttled = 0
+    for i in range(40):
+        clock.t += 0.001
+        tr = hog.create_transaction()
+        try:
+            # the throttle fires at the tagged GRV — the tenant's first
+            # read (prefix resolution) pays it, before any commit
+            tr[b"k%d" % i] = b"v"
+            tr.commit()
+            ok += 1
+        except FDBError as e:
+            assert e.code == 1213
+            throttled += 1
+        good[b"g%d" % i] = b"fine"  # never throttled
+    assert throttled > 30 and ok <= 5
+    assert len(good[b"g":b"h"]) == 40
+    # clearing the quota restores the tenant
+    TenantManagement.set_tenant_quota(db, b"hog", None)
+    clock.t += 0.001
+    hog[b"free"] = b"1"
+    c.close()
+
+
+def test_tenant_groups():
+    from foundationdb_tpu.layers.tenant import TenantManagement
+
+    c, db = _tenant_db()
+    TenantManagement.create_tenant(db, b"a1", group=b"teamA")
+    TenantManagement.create_tenant(db, b"a2", group=b"teamA")
+    TenantManagement.create_tenant(db, b"b1", group=b"teamB")
+    TenantManagement.create_tenant(db, b"solo")
+    groups = TenantManagement.list_tenant_groups(db)
+    assert groups == {b"teamA": [b"a1", b"a2"], b"teamB": [b"b1"]}
+    assert TenantManagement.get_tenant_group(db, b"a1") == b"teamA"
+    assert TenantManagement.get_tenant_group(db, b"solo") is None
+    TenantManagement.delete_tenant(db, b"a1")
+    assert TenantManagement.list_tenant_groups(db)[b"teamA"] == [b"a2"]
+    c.close()
+
+
+def test_tenant_mode_blocks_straddling_clear_ranges():
+    """Round-3 review regression: CLEAR_RANGE is judged by its whole
+    span — a plain txn must not wipe tenant space through a range that
+    merely STARTS outside it (and vice versa)."""
+    from foundationdb_tpu.core.errors import FDBError
+    from foundationdb_tpu.layers.tenant import Tenant, TenantManagement
+
+    c, db = _tenant_db()
+    TenantManagement.create_tenant(db, b"vic")
+    t = Tenant(db, b"vic")
+    t[b"data"] = b"precious"
+
+    TenantManagement.set_tenant_mode(db, "disabled")
+    with pytest.raises(FDBError) as ei:
+        db.run(lambda tr: tr.clear_range(b"a", b"\xfe"))  # straddles \xfd
+    assert ei.value.code == 2134
+    TenantManagement.set_tenant_mode(db, "optional")
+    assert t[b"data"] == b"precious"
+
+    TenantManagement.set_tenant_mode(db, "required")
+    with pytest.raises(FDBError) as ei:
+        # tenant-prefixed BEGIN but spills into \xfe user space
+        db.run(lambda tr: tr.clear_range(b"\xfd", b"\xfe\xff"))
+    assert ei.value.code == 2130
+    TenantManagement.set_tenant_mode(db, "optional")
+    c.close()
