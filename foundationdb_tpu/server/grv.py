@@ -48,7 +48,10 @@ class BatchingGrvProxy:
     deployments; the deterministic simulation keeps the synchronous
     proxy, whose rejects its workloads already ride out)."""
 
-    def __init__(self, inner, interval_s=0.0005, max_wait_s=2.0):
+    def __init__(self, inner, interval_s=0.0005, max_wait_s=2.0,
+                 start_thread=True):
+        # start_thread=False: deterministic harnesses drive
+        # _grant_round themselves (no thread, no wall clock)
         self.inner = inner
         self.interval_s = interval_s
         self.max_wait_s = max_wait_s
@@ -62,10 +65,12 @@ class BatchingGrvProxy:
         self.batches_granted = 0
         self.delayed_count = 0  # requests that waited ≥1 extra window
         self.max_round = 0  # largest single-round grant (batch size seen)
-        self._thread = threading.Thread(
-            target=self._grant_loop, name="grv-batcher", daemon=True
-        )
-        self._thread.start()
+        self._thread = None
+        if start_thread:
+            self._thread = threading.Thread(
+                target=self._grant_loop, name="grv-batcher", daemon=True
+            )
+            self._thread.start()
 
     def __getattr__(self, name):  # grv_count, sequencer, ... pass through
         return getattr(self.inner, name)
@@ -102,9 +107,7 @@ class BatchingGrvProxy:
                 # grant loop is currently holding.
                 self.inner.grv_count += 1
                 return self.inner.sequencer.committed_version
-        fut = {"event": threading.Event(), "value": None, "error": None,
-               "born": time.monotonic(), "waited": False,
-               "priority": priority}
+        fut = self._make_future(priority)
         with self._lock:
             if self._closed:
                 raise err("process_behind")
@@ -141,69 +144,7 @@ class BatchingGrvProxy:
             # sleeping on top of it would only tax per-client latency
             if n_waiting < 2 or sleep_s > self.interval_s:
                 time.sleep(sleep_s)
-            with self._lock:
-                work = {p: list(self._queues[p])
-                        for p in ("default", "batch")}
-                self._queues = {"default": [], "batch": []}
-            rk = self.inner.ratekeeper
-            if not getattr(self.inner.sequencer, "alive", True):
-                # the sequencer died with requests queued: fail them
-                # retryably rather than granting a dead authority's
-                # frozen version
-                with self._lock:
-                    n = 0
-                    for qkey in ("default", "batch"):
-                        for fut in work[qkey]:
-                            fut["error"] = err("process_behind")
-                            fut["event"].set()
-                            n += 1
-                    self._pending -= n
-                continue
-            version = None  # ONE committed-version read per grant round
-            granted_any = False
-            round_granted = 0
-            resolved = 0  # granted + aged-out: leave the _pending count
-            for qkey in ("default", "batch"):
-                queue = work[qkey]
-                # strict FIFO: grant from the head until the first denial
-                # (ONE admit call per denial — a denied head means the
-                # whole queue behind it waits, so no per-future hammering
-                # of the token bucket and no younger request overtaking)
-                n_granted = 0
-                for fut in queue:
-                    if rk is not None and not rk.admit(fut["priority"]):
-                        break
-                    if version is None:
-                        version = self.inner.sequencer.committed_version
-                        self.batches_granted += 1
-                    fut["value"] = version
-                    fut["event"].set()
-                    n_granted += 1
-                    granted_any = True
-                round_granted += n_granted
-                resolved += n_granted
-                rest = queue[n_granted:]
-                if not rest:
-                    continue
-                now = time.monotonic()
-                keep = []
-                for fut in rest:
-                    if now - fut["born"] > self.max_wait_s:
-                        fut["error"] = err("process_behind")
-                        fut["event"].set()
-                        resolved += 1
-                    else:
-                        if not fut["waited"]:
-                            fut["waited"] = True
-                            self.delayed_count += 1
-                        keep.append(fut)
-                if keep:
-                    with self._lock:  # requeue AT FRONT: FIFO preserved
-                        self._queues[qkey] = keep + self._queues[qkey]
-            with self._lock:
-                self.inner.grv_count += round_granted
-                self._pending -= resolved
-                self.max_round = max(self.max_round, round_granted)
+            granted_any = self._grant_round()
             # throttled rounds back off exponentially (cap 20ms) instead
             # of hammering the bucket every half millisecond
             sleep_s = (
@@ -211,8 +152,88 @@ class BatchingGrvProxy:
                 else min(0.02, sleep_s * 2)
             )
 
+    @staticmethod
+    def _make_future(priority, born=None):
+        """The queued-request record _grant_round consumes (one
+        construction point, shared with deterministic test drivers)."""
+        return {"event": threading.Event(), "value": None, "error": None,
+                "born": time.monotonic() if born is None else born,
+                "waited": False, "priority": priority}
+
+    def _grant_round(self, now=None):
+        """ONE grant round: drain the queues, grant strict-FIFO per
+        priority until the first denial, age out over-waited requests,
+        requeue the rest. Extracted from the loop so the deterministic
+        simulation (and tests) can drive rounds without the thread or
+        wall clock (``now`` overrides the aging clock). Returns whether
+        anything was granted."""
+        with self._lock:
+            work = {p: list(self._queues[p]) for p in ("default", "batch")}
+            self._queues = {"default": [], "batch": []}
+        rk = self.inner.ratekeeper
+        if not getattr(self.inner.sequencer, "alive", True):
+            # the sequencer died with requests queued: fail them
+            # retryably rather than granting a dead authority's
+            # frozen version
+            with self._lock:
+                n = 0
+                for qkey in ("default", "batch"):
+                    for fut in work[qkey]:
+                        fut["error"] = err("process_behind")
+                        fut["event"].set()
+                        n += 1
+                self._pending -= n
+            return False
+        version = None  # ONE committed-version read per grant round
+        granted_any = False
+        round_granted = 0
+        resolved = 0  # granted + aged-out: leave the _pending count
+        for qkey in ("default", "batch"):
+            queue = work[qkey]
+            # strict FIFO: grant from the head until the first denial
+            # (ONE admit call per denial — a denied head means the
+            # whole queue behind it waits, so no per-future hammering
+            # of the token bucket and no younger request overtaking)
+            n_granted = 0
+            for fut in queue:
+                if rk is not None and not rk.admit(fut["priority"]):
+                    break
+                if version is None:
+                    version = self.inner.sequencer.committed_version
+                    self.batches_granted += 1
+                fut["value"] = version
+                fut["event"].set()
+                n_granted += 1
+                granted_any = True
+            round_granted += n_granted
+            resolved += n_granted
+            rest = queue[n_granted:]
+            if not rest:
+                continue
+            t = time.monotonic() if now is None else now
+            keep = []
+            for fut in rest:
+                if t - fut["born"] > self.max_wait_s:
+                    fut["error"] = err("process_behind")
+                    fut["event"].set()
+                    resolved += 1
+                else:
+                    if not fut["waited"]:
+                        fut["waited"] = True
+                        self.delayed_count += 1
+                    keep.append(fut)
+            if keep:
+                with self._lock:  # requeue AT FRONT: FIFO preserved
+                    self._queues[qkey] = keep + self._queues[qkey]
+        with self._lock:
+            self.inner.grv_count += round_granted
+            self._pending -= resolved
+            self.max_round = max(self.max_round, round_granted)
+        return granted_any
+
     def close(self):
         with self._lock:
             self._closed = True
             self._wake.notify_all()
-        self._thread.join(timeout=10)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
