@@ -10,12 +10,14 @@ sequentially, as commit order requires), and statuses streamed back with
 copy_to_host_async under a small pipeline depth, so the device never
 idles waiting on the host link.
 
-The <2ms p99 half of the north star is ``conflict_check_p99_ms``:
-per-step service latency of the SINGLE-batch resolver step
-(make_resolve_fn — the latency path, Pallas ring on for TPU) at the
-production batch capacity, measured from pipelined completion deltas so
-a high-latency host link (the tunneled chip) cancels out of the
-per-step number instead of drowning it.
+The <2ms p99 half of the north star is ``conflict_check_p99_ms``: the
+DEVICE service latency of one conflict-check step (full kernel, Pallas
+ring on, production batch capacity, history threaded sequentially),
+measured by scan-length differences with forced readbacks — the
+tunneled chip's ~100ms RTT, its ~1ms per-dispatch cost, AND the axon
+backend's lying block_until_ready (it can return before computation
+finishes) all cancel or are bypassed. The chained-dispatch estimate
+rides along as ``conflict_check_dispatch_*`` for transparency.
 
 One default run prints ONE JSON line PER BASELINE CONFIG (range-heavy
 kernel, mako / tpcc / sharded-resolver / local-native e2e) with the
@@ -264,8 +266,55 @@ def stack_batches(batches, group):
     ]
 
 
+def _force(out):
+    """Wait for ``out`` to actually be COMPUTED: the axon remote
+    backend's block_until_ready can return before execution finishes
+    (it awaits the handle, not the work — measured: scan length had
+    ~zero effect on blocked wall time until a readback was added). A
+    4-byte data readback of a slice cannot lie; its (constant) cost
+    cancels in the difference estimator."""
+    import jax
+
+    leaf = jax.tree.leaves(out)[0]
+    flat = leaf.reshape(-1)
+    return np.asarray(flat[:1])
+
+
+def _difference_trials(run_block, n_short, n_long, trials):
+    """Per-step latency estimates (ms) by the link-cancelling
+    difference method: each trial times two chained blocks —
+    ``run_block(n)`` performs n sequential steps and returns something
+    to wait on — and takes (t_long - t_short) / (n_long - n_short),
+    cancelling the link's constant round-trip (and the constant
+    readback). ONE construction point for every latency metric, so
+    estimator fixes cannot diverge."""
+    estimates = []
+    for _ in range(trials):
+        times = {}
+        for n in (n_short, n_long):
+            t0 = time.perf_counter()
+            _force(run_block(n))
+            times[n] = time.perf_counter() - t0
+        estimates.append(
+            (times[n_long] - times[n_short]) / (n_long - n_short) * 1e3
+        )
+    return estimates
+
+
+def _steps_block(step_once):
+    """Adapt a one-step closure to _difference_trials' run_block."""
+
+    def run_block(n):
+        out = None
+        for _ in range(n):
+            out = step_once()
+        return out
+
+    return run_block
+
+
 def measure_conflict_check_latency(ck, params, batches, trials=24,
-                                   n_short=64, n_long=192):
+                                   n_short=64, n_long=320):
     """Per-step service latency of the single-batch resolver step — the
     conflict-check the <2ms-p99 north star is about: the latency a
     commit batch pays for resolution on production-attached hardware.
@@ -275,50 +324,101 @@ def measure_conflict_check_latency(ck, params, batches, trials=24,
     two chained sequences (n_short and n_long donated-state steps, one
     blocking sync each) and takes the DIFFERENCE: per-step =
     (t_long - t_short) / (n_long - n_short). The link's constant cost
-    cancels exactly; its jitter attenuates by the 128-step divisor.
+    cancels exactly; its jitter attenuates by the 256-step divisor.
     p99 over the trial estimates captures run-to-run device/link
     variance (device compute for a fixed shape is near-deterministic;
-    a >2ms p99 here would mean the kernel genuinely stalls). Returns
-    (p99_ms, mean_ms).
+    a >2ms p99 here would mean the kernel genuinely stalls). Measured
+    context: with a quiet tunnel the estimate settles at the true
+    device step (~0.08ms at T=1024 — consistent with the scanned
+    path's 10.7M txns/s device rate); under tunnel load it reflects
+    the link's per-dispatch cost, still comfortably under the 2ms
+    north-star. Returns (p99_ms, mean_ms).
     """
     import jax
 
     step = ck.make_resolve_fn(params, donate=True)
-    state = ck.init_state(params)
+    state = [ck.init_state(params)]
     dev = [jax.device_put(b) for b in batches[:8]]
-    status, _, state = step(state, dev[0])  # compile + warm
-    jax.block_until_ready(status)
-    estimates = []
-    for t in range(trials):
-        times = {}
-        for n in (n_short, n_long):
-            t0 = time.perf_counter()
-            for i in range(n):
-                status, _, state = step(state, dev[i % len(dev)])
-            jax.block_until_ready(status)
-            times[n] = time.perf_counter() - t0
-        estimates.append(
-            (times[n_long] - times[n_short]) / (n_long - n_short) * 1e3
-        )
-    est = np.array(estimates)
+    i = [0]
+
+    def step_once():
+        status, _, state[0] = step(state[0], dev[i[0] % len(dev)])
+        i[0] += 1
+        return status
+
+    _force(step_once())  # compile + warm
+    est = np.array(_difference_trials(
+        _steps_block(step_once), n_short, n_long, trials
+    ))
     return float(np.percentile(est, 99)), float(np.mean(est))
 
 
-def measure_kernel_step_ms(ck, params, batch, n=30):
+def measure_conflict_check_device(ck, params, batches, trials=24,
+                                  b_short=4, b_long=36):
+    """Device SERVICE latency per conflict-check step — the number a
+    production-attached chip adds to a commit. Sequential single-batch
+    steps run INSIDE lax.scan (history-threaded, Pallas ring kept on),
+    so one dispatch carries B chained steps and the scan-length
+    difference (t_long - t_short) / (b_long - b_short) cancels both the
+    link round-trip AND its per-dispatch cost — the chained-dispatch
+    estimator above is bounded by the tunnel's ~1ms/dispatch rate,
+    which no production resolver pays. Returns (p99_ms, mean_ms) over
+    the trials."""
+    import jax
+
+    scan = ck.make_resolve_scan_fn(params, donate=True, keep_pallas=True)
+    state = [ck.init_state(params)]
+
+    def stacked(B):
+        return jax.tree.map(
+            lambda *xs: np.stack(xs),
+            *[batches[i % len(batches)] for i in range(B)],
+        )
+
+    dev = {B: jax.device_put(stacked(B)) for B in (b_short, b_long)}
+
+    def run_block(B):
+        state[0], st = scan(state[0], dev[B])
+        return st
+
+    for B in (b_short, b_long):  # compile + warm both scan lengths
+        _force(run_block(B))
+    est = np.array(_difference_trials(run_block, b_short, b_long, trials))
+    # Tukey-fence lone link spikes: a tunnel hiccup lands on ONE trial
+    # as spike/divisor (measured: 11x the median while the bulk sits
+    # within 10%), whereas a genuine device tail would move the bulk —
+    # device compute for fixed shapes is near-deterministic. p99 over
+    # the fenced set is the device distribution; the UNFENCED mean is
+    # returned as the cross-check (a recurring real stall shows up
+    # there even when the fence trims it from the p99).
+    q1, q3 = np.percentile(est, [25, 75])
+    kept = est[est <= q3 + 1.5 * (q3 - q1)]
+    return float(np.percentile(kept, 99)), float(np.mean(est))
+
+
+def measure_kernel_step_ms(ck, params, batch, n_short=8, n_long=40,
+                           trials=6):
     """Device-only latency of one resolver step (the detectConflicts
-    analog): state threaded, timing excludes host status readback."""
+    analog): state threaded, timing excludes host status readback.
+    Difference method so the link's constant round-trip cancels — the
+    old single-block timing silently added RTT/n (~4ms through the
+    tunnel) to every reading. Median over ``trials`` so one jitter
+    spike in a short block cannot swing (or negate) the published
+    number."""
     import jax
 
     step = ck.make_resolve_fn(params, donate=True)
-    state = ck.init_state(params)
+    state = [ck.init_state(params)]
     batch = jax.device_put(batch)  # device-only: exclude host→device link
-    status, _, state = step(state, batch)
-    jax.block_until_ready(status)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        status, _, state = step(state, batch)
-    jax.block_until_ready(status)
-    return (time.perf_counter() - t0) / n * 1e3
+
+    def step_once():
+        status, _, state[0] = step(state[0], batch)
+        return status
+
+    _force(step_once())  # compile + warm
+    est = _difference_trials(_steps_block(step_once), n_short, n_long,
+                             trials)
+    return float(np.median(est))
 
 
 def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None):
@@ -606,11 +706,46 @@ def run_kernel_bench(point, cpu, fallback_note):
             p99, mean = measure_conflict_check_latency(
                 ck, lat_params, lat_batches, trials=lat_trials
             )
+        # the device-service estimator (scan-length difference) is the
+        # production-relevant latency; the chained-dispatch one above
+        # is bounded by the tunnel's per-dispatch cost and rides along
+        # for transparency. A Pallas-in-scan failure retries on the jnp
+        # lanes before falling back to the dispatch number, and the
+        # estimator that actually produced the headline is recorded.
+        dev_trials = int(env("BENCH_LAT_DEV_TRIALS", 16 if not cpu else 4))
+        estimator = "device"
+        try:
+            dev_p99, dev_mean = measure_conflict_check_device(
+                ck, lat_params, lat_batches, trials=dev_trials
+            )
+        except Exception as e:
+            sys.stderr.write(f"device latency path failed: {e}\n")
+            dev_p99, dev_mean = p99, mean
+            estimator = "dispatch-fallback"
+            if lat_params.use_pallas:
+                # only a Pallas config gets (and labels) a jnp retry
+                pallas_note = f"{type(e).__name__}: {e}"[:200]
+                try:
+                    dev_p99, dev_mean = measure_conflict_check_device(
+                        ck, lat_params._replace(use_pallas=False),
+                        lat_batches, trials=dev_trials,
+                    )
+                    estimator = "device-jnp"
+                except Exception as e2:
+                    sys.stderr.write(
+                        f"jnp device latency failed too: {e2}\n"
+                    )
         lat_fields = {
-            "conflict_check_p99_ms": round(p99, 3),
-            "conflict_check_mean_ms": round(mean, 3),
+            "conflict_check_p99_ms": round(dev_p99, 3),
+            "conflict_check_mean_ms": round(dev_mean, 3),
+            "conflict_check_dispatch_p99_ms": round(p99, 3),
+            "conflict_check_dispatch_mean_ms": round(mean, 3),
+            "conflict_check_estimator": estimator,
             "conflict_check_batch": lat_params.txns,
-            "pallas_kernel_step": bool(lat_params.use_pallas),
+            # False when the published latency came from the jnp retry
+            "pallas_kernel_step": bool(
+                lat_params.use_pallas and estimator != "device-jnp"
+            ),
         }
 
     committed = 0
@@ -664,7 +799,7 @@ def run_kernel_bench(point, cpu, fallback_note):
     for _ in range(dev_rounds):
         for m in dev_megas:
             state2, st2 = step(state2, m)
-    jax.block_until_ready(st2)
+    _force(st2)  # a readback: block_until_ready can lie on axon
     dev_elapsed = time.perf_counter() - t0
     device_tput = (dev_rounds * len(dev_megas) * group * params.txns
                    ) / dev_elapsed
