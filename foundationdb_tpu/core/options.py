@@ -21,6 +21,8 @@ class Knobs:
     hash_table_bits: int = 22  # point-write version table: 2^bits entries
     range_ring_capacity: int = 4096  # recent range-write ring (exact lane)
     coarse_buckets_bits: int = 14  # 2^bits contiguous key buckets (coarse lane)
+    ring_partition_bits: int = 0  # 2^bits bucket-partitioned sub-rings
+    # (0 = flat ring; >0 cuts range-check work ~2/2^bits on one device)
     key_limbs: int = 8  # 4*L bytes of exact key prefix on device
     # ring lanes via the Pallas VMEM kernel (ops/pallas_ring.py):
     # "auto" = on TPU backends, "on" = everywhere (interpreter off-TPU,

@@ -84,6 +84,16 @@ class ResolverParams(NamedTuple):
     # writes. A config that is point-only by knobs (no full twin exists)
     # keeps the old gate and records nothing nothing can read.
     record_point_coarse: bool = False
+    # Bucket-partitioned ring (single-device path): 2^bits sub-rings
+    # keyed by the begin-key's top coarse-bucket bits. A range write
+    # contained in ONE partition records exactly in its sub-ring;
+    # spanning writes fold into the coarse interval summaries
+    # (conservative). A query then checks only its two end partitions'
+    # sub-rings exactly (plus a per-partition version max for any
+    # middle partitions) — ~2/2^bits of the flat ring's pairwise work,
+    # which is what bounds range-heavy throughput on-device. 0 = flat
+    # ring (the mesh-sharded path always uses the flat ring).
+    ring_partition_bits: int = 0
 
 
 class ResolverState(NamedTuple):
@@ -136,6 +146,11 @@ from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD  # noqa: E
 def init_state(params: ResolverParams) -> ResolverState:
     kr, c, w = params.ring_capacity, 1 << params.bucket_bits, params.key_width
     u32 = jnp.uint32
+    # partitioned ring: one append cursor per sub-ring
+    head_shape = (
+        (1 << params.ring_partition_bits,)
+        if params.ring_partition_bits else ()
+    )
     return ResolverState(
         window_start=jnp.zeros((), u32),
         ht=jnp.zeros((1 << params.hash_bits,), u32),
@@ -145,7 +160,7 @@ def init_state(params: ResolverParams) -> ResolverState:
         ring_lo=jnp.zeros((kr,), jnp.int32),
         ring_hi=jnp.zeros((kr,), jnp.int32),
         ring_mask=jnp.zeros((kr,), bool),
-        ring_head=jnp.zeros((), jnp.int32),
+        ring_head=jnp.zeros(head_shape, jnp.int32),
         range_L=jnp.zeros((c,), u32),
         range_R=jnp.zeros((c,), u32),
         point_coarse=jnp.zeros((c,), u32),
@@ -265,11 +280,28 @@ def resolve_batch(
         pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
         suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
 
+    # bucket-partitioned ring (single-device path only — the mesh
+    # bucket-shards the ring across devices instead): sub-ring views +
+    # the partition shift, shared by the check and record lanes
+    PB = params.ring_partition_bits if axis_name is None else 0
+    if PB and params.range_writes:
+        P = 1 << PB
+        KRs = params.ring_capacity // P
+        pshift = params.bucket_bits - PB
+        rb_p = state.ring_b.reshape(P, KRs, params.key_width)
+        re_p = state.ring_e.reshape(P, KRs, params.key_width)
+        rv_p = state.ring_v.reshape(P, KRs)
+        rm_p = state.ring_mask.reshape(P, KRs)
+        # per-partition newest version: the conservative verdict for a
+        # query's MIDDLE partitions (its end partitions get exact checks)
+        part_max = jnp.max(jnp.where(rm_p, rv_p, u32(0)), axis=1)
+
     # the Pallas ring kernel runs the single-shard path only (each
     # shard_map lane is its own program; the jnp lanes stay canonical
-    # there); interpret mode keeps it runnable (and differential-
-    # testable) on CPU
-    pallas_ring_on = params.use_pallas and axis_name is None
+    # there; the partitioned ring has its own gather-based layout)
+    # — interpret mode keeps it runnable (and differential-testable)
+    # on CPU
+    pallas_ring_on = params.use_pallas and axis_name is None and not PB
     if pallas_ring_on:
         from foundationdb_tpu.ops.pallas_ring import ring_hits
 
@@ -293,6 +325,16 @@ def resolve_batch(
                     state.ring_v, state.ring_mask,
                     point_mode=True, interpret=interp,
                 ).reshape(T, PR)
+            elif PB:
+                # a point's partition is its bucket's partition; any
+                # single-partition entry containing it lives exactly
+                # there (spanning entries are in the coarse summaries)
+                pq = jnp.clip(batch.pr_bucket >> pshift, 0, P - 1)
+                in_rng = _point_in(
+                    batch.pr_key[:, :, None, :], rb_p[pq], re_p[pq]
+                )  # [T, PR, KRs]
+                newer = (rv_p[pq] > rv[:, None, None]) & rm_p[pq]
+                ring_hit = jnp.any(in_rng & newer, axis=2)
             else:
                 in_rng = _point_in(
                     batch.pr_key[:, :, None, :], state.ring_b[None, None], state.ring_e[None, None]
@@ -319,6 +361,31 @@ def resolve_batch(
                     state.ring_v, state.ring_mask,
                     point_mode=False, interpret=interp,
                 ).reshape(T, RR)
+            elif PB:
+                # exact checks against the query's TWO end partitions'
+                # sub-rings (equal for short scans — the common case),
+                # conservative per-partition version max for middles
+                pq_lo = jnp.clip(batch.rr_lo >> pshift, 0, P - 1)
+                pq_hi = jnp.clip(batch.rr_hi >> pshift, 0, P - 1)
+
+                def _sub_hit(pq):
+                    ov = ranges_overlap(
+                        batch.rr_b[:, :, None, :],
+                        batch.rr_e[:, :, None, :],
+                        rb_p[pq], re_p[pq],
+                    )  # [T, RR, KRs]
+                    newer = (rv_p[pq] > rv[:, None, None]) & rm_p[pq]
+                    return jnp.any(ov & newer, axis=2)
+
+                ring_hit = _sub_hit(pq_lo) | _sub_hit(pq_hi)
+                pidx = jnp.arange(P)
+                mid = (pidx[None, None, :] > pq_lo[:, :, None]) & (
+                    pidx[None, None, :] < pq_hi[:, :, None]
+                )
+                mid_max = jnp.max(
+                    jnp.where(mid, part_max[None, None, :], u32(0)), axis=2
+                )
+                ring_hit |= mid_max > rv[:, None]
             else:
                 ov = ranges_overlap(
                     batch.rr_b[:, :, None, :],
@@ -436,9 +503,46 @@ def resolve_batch(
         kr = params.ring_capacity
         own_rw = bucket_owned(batch.rw_lo)
         ok = (batch.rw_mask & own_rw & accepted[:, None]).reshape(-1)  # [T*RW]
-        slot_order = jnp.cumsum(ok) - 1  # position among accepted writes
-        pos = jnp.where(ok, (ring_head + slot_order) % kr, kr)  # kr = dropped
-        n_new = jnp.sum(ok)
+        flat_lo = batch.rw_lo.reshape(-1)
+        flat_hi = batch.rw_hi.reshape(-1)
+        if PB:
+            # single-partition entries go exactly to their sub-ring;
+            # spanning (or a flood overflowing one sub-ring in a single
+            # batch) entries fold conservatively into the coarse
+            # summaries — the same direction as eviction
+            part_lo = jnp.clip(flat_lo >> pshift, 0, P - 1)
+            part_hi = jnp.clip(flat_hi >> pshift, 0, P - 1)
+            single = part_lo == part_hi
+            ok_ring = ok & single
+            onehot = ok_ring[:, None] & (
+                part_lo[:, None] == jnp.arange(P)[None, :]
+            )
+            ranks = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+            rank = jnp.sum(jnp.where(onehot, ranks, 0), axis=1)
+            overflow = ok_ring & (rank >= KRs)
+            ok_ring = ok_ring & (rank < KRs)
+            ok_coarse = ok & (~single | overflow)
+            counts = jnp.minimum(
+                jnp.sum(onehot.astype(jnp.int32), axis=0), KRs
+            )
+            pos = jnp.where(
+                ok_ring,
+                part_lo * KRs + (ring_head[part_lo] + rank) % KRs,
+                kr,
+            )
+            new_head = ((ring_head + counts) % KRs).astype(jnp.int32)
+            c_val = jnp.where(ok_coarse, cv, u32(0))
+            range_L = range_L.at[
+                jnp.clip(flat_lo, 0, range_L.shape[0] - 1)
+            ].max(c_val)
+            range_R = range_R.at[
+                jnp.clip(flat_hi, 0, range_R.shape[0] - 1)
+            ].max(c_val)
+        else:
+            ok_ring = ok
+            slot_order = jnp.cumsum(ok) - 1  # position among accepted
+            pos = jnp.where(ok, (ring_head + slot_order) % kr, kr)
+            new_head = ((ring_head + jnp.sum(ok)) % kr).astype(jnp.int32)
         # fold evicted entries into the coarse interval summary first
         will_evict = jnp.zeros((kr,), bool).at[pos].set(True, mode="drop")
         evict = will_evict & ring_mask
@@ -450,11 +554,11 @@ def resolve_batch(
         flat_e = batch.rw_e.reshape(-1, params.key_width)
         ring_b = ring_b.at[pos].set(flat_b, mode="drop")
         ring_e = ring_e.at[pos].set(flat_e, mode="drop")
-        ring_v = ring_v.at[pos].set(jnp.where(ok, cv, u32(0)), mode="drop")
-        ring_lo = ring_lo.at[pos].set(batch.rw_lo.reshape(-1), mode="drop")
-        ring_hi = ring_hi.at[pos].set(batch.rw_hi.reshape(-1), mode="drop")
-        ring_mask = ring_mask.at[pos].set(ok, mode="drop")
-        ring_head = ((ring_head + n_new) % kr).astype(jnp.int32)
+        ring_v = ring_v.at[pos].set(jnp.where(ok_ring, cv, u32(0)), mode="drop")
+        ring_lo = ring_lo.at[pos].set(flat_lo, mode="drop")
+        ring_hi = ring_hi.at[pos].set(flat_hi, mode="drop")
+        ring_mask = ring_mask.at[pos].set(ok_ring, mode="drop")
+        ring_head = new_head
         # folds target arbitrary buckets; sync the replicated summaries
         range_L = pmax_arr(range_L)
         range_R = pmax_arr(range_R)
@@ -488,6 +592,25 @@ def validate_params(params: ResolverParams):
         )
     if params.bucket_bits > 30 or params.hash_bits > 28:
         raise ValueError("bucket_bits/hash_bits unreasonably large")
+    pb = params.ring_partition_bits
+    if pb:
+        if pb > params.bucket_bits:
+            raise ValueError(
+                "ring_partition_bits exceeds bucket_bits: partitions are "
+                "keyed by the top coarse-bucket bits"
+            )
+        if params.ring_capacity % (1 << pb):
+            raise ValueError(
+                "ring_capacity must divide evenly into 2^ring_partition_bits "
+                "sub-rings"
+            )
+        if params.use_pallas:
+            raise ValueError(
+                "ring_partition_bits and use_pallas are mutually "
+                "exclusive: the Pallas VMEM kernel implements the FLAT "
+                "ring layout (silently ignoring the explicit pallas "
+                "request would misattribute benchmarks)"
+            )
 
 
 def make_resolve_fn(params: ResolverParams, donate=True):

@@ -74,8 +74,12 @@ class MeshResolver(Resolver):
         self.n_lanes = int(mesh.devices.size)
         # use_pallas stays False: the Pallas ring kernel is single-shard
         # only (each shard_map lane is its own program); the mesh runs
-        # the jnp lanes
-        self.params = params_from_knobs(knobs, use_pallas=False)
+        # the jnp lanes. ring_partition_bits too — the mesh already
+        # bucket-shards the ring ACROSS devices; partitioning within a
+        # shard would nest two ownership schemes.
+        self.params = params_from_knobs(knobs, use_pallas=False)._replace(
+            ring_partition_bits=0
+        )
         self.packer = BatchPacker(self.params)
         self._kernel = ShardedResolverKernel(self.params, mesh=self.mesh)
         self.state = self._kernel.state

@@ -71,6 +71,7 @@ def params_from_knobs(knobs, use_pallas=False):
         hash_bits=knobs.hash_table_bits,
         ring_capacity=knobs.range_ring_capacity,
         bucket_bits=knobs.coarse_buckets_bits,
+        ring_partition_bits=knobs.ring_partition_bits,
         use_pallas=use_pallas,
     )
 
@@ -99,6 +100,11 @@ class Resolver:
             use_pallas = pallas == "on" or (
                 pallas == "auto" and jax.default_backend() == "tpu"
             )
+            if getattr(knobs, "ring_partition_bits", 0) and pallas == "auto":
+                # the Pallas kernel implements the FLAT ring; a
+                # partitioned ring under "auto" downgrades to the jnp
+                # lanes (an explicit "on" is rejected by validate_params)
+                use_pallas = False
             self.params = params_from_knobs(knobs, use_pallas=use_pallas)
             self.packer = BatchPacker(self.params)
             self.state = ck.init_state(self.params)
