@@ -125,6 +125,9 @@ class Cli:
             "  getversion                      current read version",
             "  status [json]                   cluster status",
             "  tenant create|delete|list|get   manage tenants",
+            "  tenant mode [MODE]              optional|required|disabled",
+            "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
+            "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
@@ -307,10 +310,57 @@ class Cli:
             key = parse_key(args[1])
             if key in names:
                 self._p(f"The tenant `{args[1]}' exists")
+                quota = TM.get_tenant_quota(self.db, key)
+                if quota is not None:
+                    self._p(f"  quota: {quota} tps")
+                group = TM.get_tenant_group(self.db, key)
+                if group is not None:
+                    self._p(f"  group: {format_key(group)}")
             else:
                 self._p(f"ERROR: Tenant `{args[1]}' does not exist")
+        elif sub == "mode":
+            # ref: the tenant_mode configuration knob
+            if len(args) > 1:
+                TM.set_tenant_mode(self.db, args[1])
+                self._p(f"Tenant mode set to `{args[1]}'")
+            else:
+                self._p(TM.get_tenant_mode(self.db))
+        elif sub == "quota":
+            # tenant quota NAME [TPS|clear] (ref: fdbcli quota)
+            key = parse_key(args[1])
+            if len(args) > 2:
+                tps = None if args[2] == "clear" else float(args[2])
+                TM.set_tenant_quota(self.db, key, tps)
+                self._p(
+                    f"Quota for `{args[1]}' "
+                    + ("cleared" if tps is None else f"set to {tps} tps")
+                )
+            else:
+                quota = TM.get_tenant_quota(self.db, key)
+                self._p("no quota" if quota is None else f"{quota} tps")
         else:
             raise ValueError(f"unknown tenant subcommand {sub}")
+
+    def _cmd_throttle(self, args):
+        """Ref: fdbcli throttle — per-tag rate limits. ``throttle on
+        tag TAG RATE`` / ``throttle off tag TAG`` / ``throttle list``."""
+        cluster = self.db._cluster
+        if args and args[0] == "list":
+            tags = (cluster.ratekeeper.throttled_tags()
+                    if hasattr(cluster, "ratekeeper") else {})
+            if not tags:
+                self._p("There are no throttled tags")
+            for tag, tps in sorted(tags.items()):
+                self._p(f"  {tag}: {tps} tps")
+        elif len(args) >= 4 and args[0] == "on" and args[1] == "tag":
+            cluster.set_tag_quota(args[2], float(args[3]))
+            self._p(f"Tag `{args[2]}' throttled at {args[3]} tps")
+        elif len(args) >= 3 and args[0] == "off" and args[1] == "tag":
+            cluster.set_tag_quota(args[2], None)
+            self._p(f"Tag `{args[2]}' unthrottled")
+        else:
+            raise ValueError("usage: throttle list | on tag TAG TPS | "
+                             "off tag TAG")
 
 
 def main(argv=None):

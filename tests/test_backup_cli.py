@@ -235,3 +235,42 @@ class TestTrace:
         with open(path) as f:
             rec = json.loads(f.readline())
         assert rec["type"] == "ToDisk" and rec["n"] == 3
+
+
+# ── round-3 cli: tenant mode/quota + throttle ───────────────────────────
+def test_cli_tenant_mode_quota_and_throttle():
+    import io
+
+    from conftest import TEST_KNOBS
+    from foundationdb_tpu.server.cluster import Cluster
+    from foundationdb_tpu.tools.cli import Cli
+
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    db = c.database()
+    out = io.StringIO()
+    cli = Cli(db, out=out)
+    cli.write_mode = True
+    cli.run_command("tenant create acme")
+    cli.run_command("tenant quota acme 25")
+    cli.run_command("tenant get acme")
+    cli.run_command("tenant mode required")
+    cli.run_command("tenant mode")
+    cli.run_command("throttle on tag etl 10")
+    cli.run_command("throttle list")
+    cli.run_command("throttle off tag etl")
+    cli.run_command("tenant quota acme clear")
+    cli.run_command("tenant mode optional")
+    text = out.getvalue()
+    assert "has been created" in text
+    assert "set to 25.0 tps" in text
+    assert "quota: 25.0 tps" in text
+    assert "Tenant mode set to `required'" in text
+    assert "\nrequired\n" in text
+    assert "etl: 10.0 tps" in text
+    assert "unthrottled" in text
+    # the knobs actually landed
+    from foundationdb_tpu.layers.tenant import TenantManagement, tenant_tag
+    assert TenantManagement.get_tenant_mode(db) == "optional"
+    assert TenantManagement.get_tenant_quota(db, b"acme") is None
+    assert tenant_tag(b"acme") not in c.ratekeeper.tag_quotas
+    c.close()
