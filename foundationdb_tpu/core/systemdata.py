@@ -13,6 +13,9 @@ import json
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"  # '0' = '/'+1
 CONF_REPLICATION = b"\xff/conf/replication"
+# Database lock uid (ref: fdbclient/SystemData.cpp databaseLockedKey) —
+# persisted so the lock survives recovery and rides the DR seed/stream.
+DB_LOCKED = b"\xff/dbLocked"
 
 
 def encode_shard_map(shard_map):

@@ -149,6 +149,7 @@ class Cluster:
         from foundationdb_tpu.server.datadistribution import ShardMap
 
         restored_map = None
+        arg_replication = replication
         if recovered_records:
             s0 = self.storages[0]
             rows = s0.read_range(
@@ -161,8 +162,22 @@ class Cluster:
                 rep_row = s0.get(systemdata.CONF_REPLICATION, s0.version)
                 if rep_row is not None:
                     replication = int(rep_row)
-                TraceEvent("ShardMapRestored").detail(
-                    shards=len(restored_map), replication=replication).log()
+                # A persisted map can name a DIFFERENT storage fleet than
+                # this incarnation has (a DR failover recovers the
+                # primary's keyServers rows into the satellite's cluster
+                # shape): validate team indices; a mismatched map falls
+                # back to full replication, like a decode failure.
+                fleet = len(self.storages)
+                if any(sid >= fleet for team in restored_map.teams
+                       for sid in team) or (replication or 0) > fleet:
+                    TraceEvent("ShardMapFleetMismatch", severity=30).detail(
+                        shards=len(restored_map),
+                        map_replication=replication, fleet=fleet).log()
+                    restored_map, replication = None, arg_replication
+                else:
+                    TraceEvent("ShardMapRestored").detail(
+                        shards=len(restored_map),
+                        replication=replication).log()
         self.replication = replication or n_storage
         self.dd = DataDistributor(
             self.storages, shard_map=restored_map,
@@ -189,14 +204,18 @@ class Cluster:
             self._restore_tenant_config()
 
     def _restore_tenant_config(self):
-        """Re-apply persisted tenant mode + quotas after recovery (both
-        live in the system keyspace; enforcement is proxy/ratekeeper
-        state that died with the old process)."""
+        """Re-apply persisted tenant mode + quotas + lock state after
+        recovery (all live in the system keyspace; enforcement is
+        proxy/ratekeeper state that died with the old process)."""
+        from foundationdb_tpu.core import systemdata
         from foundationdb_tpu.layers.tenant import (
             TENANT_MODE_KEY, TENANT_QUOTA_PREFIX, tenant_tag,
         )
 
         s0 = self.storages[0]
+        lock_row = s0.get(systemdata.DB_LOCKED, s0.version)
+        if lock_row is not None:
+            self._commit_target().lock_uid = lock_row
         mode_row = s0.get(TENANT_MODE_KEY, s0.version)
         if mode_row is not None:
             self._commit_target().tenant_mode = mode_row.decode()
@@ -543,10 +562,29 @@ class Cluster:
 
     def lock_database(self, uid=b"lock"):
         """Ref: ManagementAPI lockDatabase — commits from transactions
-        without the lock_aware option fail 1038 until unlocked."""
-        self._commit_target().lock_uid = bytes(uid)
+        without the lock_aware option fail 1038 until unlocked. The uid
+        persists as the \\xff/dbLocked system row (ref:
+        databaseLockedKey) so the lock survives WAL recovery and rides
+        the DR seed/stream; enforcement stays at the proxy."""
+        from foundationdb_tpu.core import systemdata
+
+        uid = bytes(uid)
+
+        def txn(tr):
+            tr.options.set_lock_aware()
+            tr.set(systemdata.DB_LOCKED, uid)
+
+        self.database().run(txn)
+        self._commit_target().lock_uid = uid
 
     def unlock_database(self):
+        from foundationdb_tpu.core import systemdata
+
+        def txn(tr):
+            tr.options.set_lock_aware()
+            tr.clear(systemdata.DB_LOCKED)
+
+        self.database().run(txn)
         self._commit_target().lock_uid = None
 
     def lock_uid(self):

@@ -56,14 +56,21 @@ class SecondaryRegion:
         from the log — DR starts with a full copy, then tails (ref:
         fdbdr's initial range copy before mutation streaming). The
         snapshot rides as ONE synthetic log record at its read version;
-        promotion replays it like any other record."""
+        promotion replays it like any other record. The scan runs through
+        the SYSTEM keyspace (end b"\\xff\\xff", matching
+        storage_owned_ranges' everywhere-replicated treatment of
+        [\\xff, \\xff\\xff)): the tailed log replicates system mutations,
+        so the seed must carry the pre-attach system state too — tenant
+        map/modes/quotas, lock uid — or the promoted cluster would hold
+        tenant data its tenant map has never heard of."""
         db = self.primary.database()
         tr = db.create_transaction()
         v = tr.get_read_version()
         muts = []
         begin = b""
         while True:
-            rows = tr.get_range(begin, b"\xff", limit=1000, snapshot=True)
+            rows = tr.get_range(begin, b"\xff\xff", limit=1000,
+                                snapshot=True)
             muts.extend(Mutation(Op.SET, k, val) for k, val in rows)
             if len(rows) < 1000:
                 break

@@ -266,6 +266,8 @@ class TLogSystem:
     # ── single-TLog facade ──
     @property
     def _first_version(self):
+        if self.live_count == 0:
+            raise TLogDown("no live tlog replicas")
         return min(l._first_version for l in self.logs if l.alive)
 
     @_first_version.setter
@@ -337,6 +339,8 @@ class TLogSystem:
 
     @property
     def last_version(self):
+        if self.live_count == 0:
+            raise TLogDown("no live tlog replicas")
         return max(l.last_version for l in self.logs if l.alive)
 
     def close(self):

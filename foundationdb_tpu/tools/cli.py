@@ -346,8 +346,11 @@ class Cli:
         tag TAG RATE`` / ``throttle off tag TAG`` / ``throttle list``."""
         cluster = self.db._cluster
         if args and args[0] == "list":
-            tags = (cluster.ratekeeper.throttled_tags()
-                    if hasattr(cluster, "ratekeeper") else {})
+            # Read through status json so a RemoteCluster (which has no
+            # local ratekeeper attribute) reports the truth instead of
+            # always printing "no throttled tags".
+            tags = (self.db.status().get("cluster", {})
+                    .get("qos", {}).get("throttled_tags", {}) or {})
             if not tags:
                 self._p("There are no throttled tags")
             for tag, tps in sorted(tags.items()):

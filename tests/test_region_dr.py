@@ -184,3 +184,78 @@ def test_primary_restart_gap_is_detected_not_torn(tmp_path):
     finally:
         promoted.close()
     primary3.close()
+
+
+def test_seed_carries_system_keyspace(tmp_path):
+    """ADVICE r3 (high): a tenant created BEFORE the satellite attaches
+    must exist on the promoted cluster — the seed snapshot has to scan
+    through the system keyspace (tenant map, modes, quotas), not stop at
+    b'\\xff', or failover promotes a database holding \\xfd-prefixed
+    tenant data its tenant map has never heard of."""
+    from foundationdb_tpu.layers.tenant import TenantManagement, Tenant
+
+    primary = Cluster(n_storage=2, resolver_backend="cpu", **TEST_KNOBS)
+    db = primary.database()
+    TenantManagement.create_tenant(db, b"acme", group=b"g1")
+    TenantManagement.set_tenant_quota(db, b"acme", 500.0)
+    Tenant(db, b"acme").set(b"k", b"pre-attach")
+    init_perm(db)
+
+    dr = SecondaryRegion(primary, str(tmp_path / "sat.wal"))
+    dr.pump()
+    promoted = dr.failover(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        pdb = promoted.database()
+        # tenant map arrived with the seed: the tenant opens and reads
+        assert Tenant(pdb, b"acme").get(b"k") == b"pre-attach"
+        names = [n for n, _ in TenantManagement.list_tenants(pdb)]
+        assert b"acme" in names
+        assert TenantManagement.get_tenant_quota(pdb, b"acme") == 500.0
+        assert_perm(read_perm(pdb))
+    finally:
+        promoted.close()
+    primary.close()
+
+
+def test_pump_survives_all_replicas_transiently_dead(tmp_path):
+    """ADVICE r3 (low): when every tlog replica is transiently dead,
+    the gap check's _first_version read must surface as TLogDown
+    ('retry next round'), not a ValueError escaping the pump loop."""
+    primary = Cluster(n_storage=2, n_tlogs=3, resolver_backend="cpu",
+                      **TEST_KNOBS)
+    db = primary.database()
+    init_perm(db)
+    dr = SecondaryRegion(primary, str(tmp_path / "sat.wal"))
+    dr.pump()
+    for log in primary.tlog.logs:
+        log.kill()
+    assert dr.pump() == 0 and not dr.broken  # retryable, not an error
+    for log in primary.tlog.logs:  # transient outage: processes return
+        log.alive = True           # with their state intact
+    swap_txn(db, random.Random(9))
+    assert dr.pump() > 0
+    primary.close()
+
+
+def test_failover_into_smaller_fleet_discards_foreign_shard_map(tmp_path):
+    """The seeded system keyspace carries the PRIMARY's \\xff/keyServers/
+    shard map; a promoted cluster with a different storage fleet must
+    not restore teams naming storages it doesn't have — it falls back to
+    full replication (like a decode failure) instead of raising
+    IndexError on the first routed read."""
+    primary = Cluster(n_storage=4, replication=2, resolver_backend="cpu",
+                      **TEST_KNOBS)
+    db = primary.database()
+    init_perm(db)
+    primary.rebalance()  # persist a 4-storage shard map
+    dr = SecondaryRegion(primary, str(tmp_path / "sat.wal"))
+    dr.pump()
+    promoted = dr.failover(resolver_backend="cpu", **TEST_KNOBS)  # 1 storage
+    try:
+        pdb = promoted.database()
+        assert_perm(read_perm(pdb))  # routed reads work
+        pdb.run(lambda tr: tr.set(b"post", b"failover"))
+        assert pdb.run(lambda tr: tr.get(b"post")) == b"failover"
+    finally:
+        promoted.close()
+    primary.close()

@@ -144,3 +144,27 @@ def test_tags_over_rpc():
     finally:
         server.close()
         c.close()
+
+
+def test_cli_throttle_list_over_rpc():
+    """ADVICE r3 (low): `throttle list` must report through status json
+    so a RemoteCluster (no local ratekeeper attribute) shows the truth
+    instead of always printing 'no throttled tags'."""
+    import io
+
+    from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+    from foundationdb_tpu.tools.cli import Cli
+
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    c.ratekeeper.set_tag_quota("hog", 7.0)
+    server = serve_cluster(c)
+    try:
+        remote = RemoteCluster(server.address)
+        out = io.StringIO()
+        Cli(remote.database(), out=out).run_command("throttle list")
+        text = out.getvalue()
+        assert "hog" in text and "7" in text, text
+        remote.close()
+    finally:
+        server.close()
+        c.close()
