@@ -45,6 +45,7 @@ class Cluster:
                  replication=None, commit_pipeline="sync",
                  commit_batch_max=None, commit_flush_after=4,
                  target_tps=None, rk_clock=None, n_tlogs=1, fsync=False,
+                 n_commit_proxies=1,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -197,9 +198,8 @@ class Cluster:
         self._commit_batch_max = commit_batch_max
         self._commit_flush_after = commit_flush_after
         self.recruitments = 0  # roles replaced by the failure monitor
-        self.commit_proxy, self.grv_proxy = self._wire_pipeline(
-            self._make_commit_proxy()
-        )
+        self.n_commit_proxies = n_commit_proxies
+        self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()
 
@@ -226,12 +226,44 @@ class Cluster:
                 tenant_tag(k[len(TENANT_QUOTA_PREFIX):]), float(v)
             )
 
-    def _make_commit_proxy(self):
+    def _make_commit_proxy(self, resolve_gate=None, log_gate=None):
         return CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
             self.knobs, self.ratekeeper, dd=self.dd,
             change_feeds=self.change_feeds,
+            resolve_gate=resolve_gate, log_gate=log_gate,
         )
+
+    def _build_txn_frontend(self):
+        """Build the transaction frontend: one commit proxy + GRV proxy
+        (the default; sims and single-threaded deployments), or a FLEET
+        of ``n_commit_proxies`` of each with sequencer-chained versions
+        and ordered pipeline gates (ref: the reference's proxy fleets;
+        see server/fleet.py). Used for first boot AND txn-system
+        recovery — the two incarnations must never diverge."""
+        if self.n_commit_proxies <= 1:
+            return self._wire_pipeline(self._make_commit_proxy())
+        from foundationdb_tpu.server.fleet import GrvFleet, ProxyFleet
+        from foundationdb_tpu.server.proxy import VersionGate
+
+        start = self.sequencer.committed_version
+        resolve_gate, log_gate = VersionGate(start), VersionGate(start)
+        inners, members, grvs = [], [], []
+        for _ in range(self.n_commit_proxies):
+            inner = self._make_commit_proxy(
+                resolve_gate=resolve_gate, log_gate=log_gate
+            )
+            wrapped, grv = self._wire_pipeline(inner)
+            inners.append(inner)
+            members.append(wrapped)
+            grvs.append(grv)
+        return ProxyFleet(members, inners), GrvFleet(grvs)
+
+    def _inner_proxies(self):
+        cp = self.commit_proxy
+        if hasattr(cp, "inners"):
+            return list(cp.inners)
+        return [getattr(cp, "inner", cp)]
 
     def _wire_pipeline(self, inner):
         """Wrap a bare CommitProxy + fresh GrvProxy in the configured
@@ -328,18 +360,23 @@ class Cluster:
         (their windows open at the recovery version, so pre-death read
         versions retry TOO_OLD), and recruit fresh proxies over the
         SAME storages/logs — data is not torn down or re-ingested."""
+        import contextlib
+
         old_proxy = self.commit_proxy
-        old_target = self._commit_target()
+        old_inners = self._inner_proxies()
         # Quiesce: mark both roles dead FIRST (future batches answer
-        # 1021 at the entry check / SequencerDown guard), then take the
-        # old proxy's commit mutex — an in-flight batch that already
-        # passed the check finishes under the OLD generation before we
-        # read the log frontier, so every acked commit is covered by
-        # ``recovered`` (no acked-but-invisible writes, no overlapping
-        # version grants into the shared tlog).
-        old_target.kill()
+        # 1021 at the entry check / SequencerDown guard), then take
+        # EVERY old proxy's commit mutex — in-flight batches that
+        # already passed the check finish under the OLD generation
+        # before we read the log frontier, so every acked commit is
+        # covered by ``recovered`` (no acked-but-invisible writes, no
+        # overlapping version grants into the shared tlog).
+        for p in old_inners:
+            p.kill()
         self.sequencer.kill()
-        with old_target._commit_mu:
+        with contextlib.ExitStack() as stack:
+            for p in old_inners:
+                stack.enter_context(p._commit_mu)
             recovered = max(
                 self.tlog.last_version, self.sequencer.committed_version
             )
@@ -351,17 +388,19 @@ class Cluster:
         # fence conflict history: in-flight txns retry with fresh reads
         for i, r in enumerate(self.resolvers):
             self.resolvers[i] = r.respawn(recovered)
-        inner = self._make_commit_proxy()
         # the database lock and tenant mode are cluster state, not proxy
         # state: survive the recovery (ref: both living in the system
         # keyspace)
-        if getattr(old_target, "lock_uid", None) is not None:
-            inner.lock_uid = old_target.lock_uid
-        if getattr(old_target, "tenant_mode", None) is not None:
-            inner.tenant_mode = old_target.tenant_mode
-        inner.update_resolver_ranges(fence=False)
+        lock_uid = getattr(old_inners[0], "lock_uid", None)
+        tenant_mode = getattr(old_inners[0], "tenant_mode", None)
         old_grv = self.grv_proxy
-        self.commit_proxy, self.grv_proxy = self._wire_pipeline(inner)
+        self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
+        target = self._commit_target()
+        if lock_uid is not None:
+            target.lock_uid = lock_uid
+        if tenant_mode is not None:
+            target.tenant_mode = tenant_mode
+        target.update_resolver_ranges(fence=False)
         if self.commit_pipeline != "sync":
             # queued commits raced the death: resolve them 1021 so
             # their clients retry against the new generation
@@ -696,7 +735,8 @@ class Cluster:
                 "commit_pipeline": self.commit_pipeline,
                 "processes": {
                     "sequencer": {"alive": self.sequencer.alive},
-                    "commit_proxy": {"alive": self._commit_target().alive},
+                    "commit_proxy": {"alive": self._commit_target().alive,
+                                     "count": self.n_commit_proxies},
                     "resolvers": [
                         {"id": i, "alive": r.alive,
                          "backend": self.knobs.resolver_backend,

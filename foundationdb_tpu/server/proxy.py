@@ -20,10 +20,42 @@ from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
 
 
+class VersionGate:
+    """Version-ordered turnstile for a commit-proxy FLEET (ref: the
+    sequencer's prevVersion chaining + the resolvers/tlogs processing
+    batches in version order). A batch granted (prev, v) may only pass
+    once every earlier grant has passed: ``enter(prev)`` blocks until
+    the gate's frontier reaches ``prev``; ``advance(v)`` moves it. Two
+    gates order the two stateful pipeline stages independently (resolve
+    history; log+storage apply), so proxy B packs and routes while
+    proxy A resolves — the fleet pipelines, the state stays serial."""
+
+    def __init__(self, start):
+        self._v = start
+        self._cond = threading.Condition()
+
+    def enter(self, prev, timeout=60.0):
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._v >= prev, timeout):
+                raise RuntimeError(
+                    f"version gate stuck at {self._v}, waiting for {prev}"
+                )
+
+    def advance(self, v):
+        with self._cond:
+            if v > self._v:
+                self._v = v
+            self._cond.notify_all()
+
+
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
-                 ratekeeper=None, dd=None, change_feeds=None):
+                 ratekeeper=None, dd=None, change_feeds=None,
+                 resolve_gate=None, log_gate=None):
         self.alive = True
+        # fleet ordering (None when this proxy is the whole fleet)
+        self.resolve_gate = resolve_gate
+        self.log_gate = log_gate
         self.sequencer = sequencer
         self.resolvers = resolvers  # list; key-range sharded when >1
         self.tlog = tlog
@@ -179,7 +211,7 @@ class CommitProxy:
             if out is not None:
                 return out
         try:
-            cv = self.sequencer.next_commit_version()
+            prev, cv = self.sequencer.next_commit_versions(1)[0]
         except SequencerDown:
             # the kill raced past the entry check (TOCTOU): same honest
             # 1021 — a raw exception here would strand batcher futures
@@ -190,13 +222,39 @@ class CommitProxy:
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
         txns = self._build_txns(requests)
         try:
-            statuses = self._resolve(txns, cv, window)
+            statuses = self._resolve_ordered(txns, cv, window, prev)
         except ResolverDown:
             # resolution never ran: definitively not committed (1020,
             # retryable without 1021 disambiguation); the failure monitor
-            # recruits a fenced replacement resolver
+            # recruits a fenced replacement resolver. The granted version
+            # still consumes its log turn or the fleet would deadlock.
+            self._skip_turn(self.log_gate, prev, cv)
             return [FDBError.from_name("not_committed") for _ in requests]
-        return self._finalize_batch(requests, txns, statuses, cv, window)
+        return self._finalize_batch(requests, txns, statuses, cv, window,
+                                    prev)
+
+    def _resolve_ordered(self, txns, cv, window, prev):
+        """Resolution in global version order: conflict history is
+        stateful, so the fleet's batches enter it exactly in grant
+        order (ref: Resolver.actor.cpp queuing requests by sequence)."""
+        if self.resolve_gate is None:
+            return self._resolve(txns, cv, window)
+        self.resolve_gate.enter(prev)
+        try:
+            return self._resolve(txns, cv, window)
+        finally:
+            # advance even on failure: the version is consumed either way
+            self.resolve_gate.advance(cv)
+
+    @staticmethod
+    def _skip_turn(gate, prev, cv):
+        """Consume a granted version's turn at ``gate`` without doing
+        its work (failed batch): successors must never wait on a turn
+        no one will take. Still waits for order — advancing early would
+        let a LATER version pass before an EARLIER one logged."""
+        if gate is not None:
+            gate.enter(prev)
+            gate.advance(cv)
 
     def commit_batches(self, request_batches):
         """Commit a BACKLOG of batches: each gets its own commit version,
@@ -220,33 +278,50 @@ class CommitProxy:
             return self._commit_batches_locked(request_batches)
 
     def _commit_batches_locked(self, request_batches):
-        metas = []
         try:
-            for reqs in request_batches:
-                cv = self.sequencer.next_commit_version()
-                window = max(
-                    0, cv - self.knobs.max_read_transaction_life_versions
-                )
-                metas.append((reqs, self._build_txns(reqs), cv, window))
+            # the whole backlog's versions in ONE chained grant: no other
+            # proxy's batch can land inside this run, so the backlog is
+            # contiguous in the global order and one gate span covers it
+            pairs = self.sequencer.next_commit_versions(len(request_batches))
         except SequencerDown:
             return [
                 [FDBError.from_name("commit_unknown_result") for _ in reqs]
                 for reqs in request_batches
             ]
+        first_prev, last_cv = pairs[0][0], pairs[-1][1]
+        metas = []
+        for reqs, (prev, cv) in zip(request_batches, pairs):
+            window = max(
+                0, cv - self.knobs.max_read_transaction_life_versions
+            )
+            metas.append((reqs, self._build_txns(reqs), cv, window))
+        if self.resolve_gate is not None:
+            self.resolve_gate.enter(first_prev)
         try:
             statuses_list = self.resolvers[0].resolve_many(
                 [(txns, cv, window) for _, txns, cv, window in metas]
             )
         except ResolverDown:
+            self._skip_turn(self.log_gate, first_prev, last_cv)
             return [
                 [FDBError.from_name("not_committed") for _ in reqs]
                 for reqs in request_batches
             ]
-        return [
-            self._finalize_batch(reqs, txns, statuses, cv, window)
-            for (reqs, txns, cv, window), statuses
-            in zip(metas, statuses_list)
-        ]
+        finally:
+            if self.resolve_gate is not None:
+                self.resolve_gate.advance(last_cv)
+        if self.log_gate is not None:
+            self.log_gate.enter(first_prev)
+        try:
+            return [
+                self._finalize_batch(reqs, txns, statuses, cv, window,
+                                     prev=None)
+                for (reqs, txns, cv, window), statuses
+                in zip(metas, statuses_list)
+            ]
+        finally:
+            if self.log_gate is not None:
+                self.log_gate.advance(last_cv)
 
     def _build_txns(self, requests):
         return [
@@ -260,34 +335,73 @@ class CommitProxy:
             for r in requests
         ]
 
-    def _finalize_batch(self, requests, txns, statuses, cv, window):
+    def _finalize_batch(self, requests, txns, statuses, cv, window,
+                        prev=None):
         """Everything after resolution: result assembly, DD accounting,
         tlog push (1021 on quorum loss), storage apply, change feeds,
-        version reporting, admission + durability pumping."""
-        results = []
-        batch_mutations = []
-        batch_conflicts = 0
-        for i, (req, st) in enumerate(zip(requests, statuses)):
-            if st == COMMITTED:
-                muts = [
-                    substitute_versionstamp(m, cv, batch_order=0, txn_order=i)
-                    if m.op in (Op.SET_VERSIONSTAMPED_KEY, Op.SET_VERSIONSTAMPED_VALUE)
-                    else m
-                    for m in req.mutations
-                ]
-                batch_mutations.extend(muts)
-                results.append(cv)
-            elif st == TOO_OLD:
-                results.append(FDBError.from_name("transaction_too_old"))
-                batch_conflicts += 1
-            else:
-                e = FDBError.from_name("not_committed")
-                if req.report_conflicting_keys:
-                    e.conflicting_key_ranges = self._conflicting_ranges(
-                        txns[i]
-                    )
-                results.append(e)
-                batch_conflicts += 1
+        version reporting, admission + durability pumping. ``prev``
+        orders this batch behind the fleet's earlier grants at the log
+        gate (None = the caller already holds the order); assembly and
+        routing run OUTSIDE the ordered section so a fleet overlaps
+        them with another proxy's push."""
+        try:
+            results = []
+            batch_mutations = []
+            batch_conflicts = 0
+            for i, (req, st) in enumerate(zip(requests, statuses)):
+                if st == COMMITTED:
+                    muts = [
+                        substitute_versionstamp(m, cv, batch_order=0, txn_order=i)
+                        if m.op in (Op.SET_VERSIONSTAMPED_KEY, Op.SET_VERSIONSTAMPED_VALUE)
+                        else m
+                        for m in req.mutations
+                    ]
+                    batch_mutations.extend(muts)
+                    results.append(cv)
+                elif st == TOO_OLD:
+                    results.append(FDBError.from_name("transaction_too_old"))
+                    batch_conflicts += 1
+                else:
+                    e = FDBError.from_name("not_committed")
+                    if req.report_conflicting_keys:
+                        e.conflicting_key_ranges = self._conflicting_ranges(
+                            txns[i]
+                        )
+                    results.append(e)
+                    batch_conflicts += 1
+
+            # Route BEFORE the push so the log stores the per-tag split
+            # (ref: applyMetadataToCommittedTransactions tagging mutations
+            # with storage tags, TLogServer's per-tag streams): storage
+            # workers then peek only their own stream. Full replication
+            # skips tags — every tag's stream IS the full batch.
+            routed = self._route(batch_mutations)
+            tags = None
+            if (self.dd is not None
+                    and self.dd.replication < len(self.storages)):
+                tags = dict(enumerate(routed))
+        except BaseException:
+            # assembly blew up before the ordered section: the version's
+            # log turn must still be consumed or successors hang
+            if prev is not None:
+                self._skip_turn(self.log_gate, prev, cv)
+            raise
+        if prev is not None and self.log_gate is not None:
+            self.log_gate.enter(prev)
+        try:
+            return self._finalize_ordered(
+                requests, results, batch_mutations, batch_conflicts,
+                routed, tags, cv, window,
+            )
+        finally:
+            if prev is not None and self.log_gate is not None:
+                self.log_gate.advance(cv)
+
+    def _finalize_ordered(self, requests, results, batch_mutations,
+                          batch_conflicts, routed, tags, cv, window):
+        """The version-ordered tail of the pipeline: counters, DD load
+        samples, the tlog push, storage apply, feeds, and reporting —
+        everything that mutates shared cluster state."""
         self.conflict_count += batch_conflicts
         self.commit_count += sum(1 for r in results if not isinstance(r, FDBError))
 
@@ -302,15 +416,6 @@ class CommitProxy:
                         m.key, len(m.key) + len(m.param or b"")
                     )
 
-        # Route BEFORE the push so the log stores the per-tag split
-        # (ref: applyMetadataToCommittedTransactions tagging mutations
-        # with storage tags, TLogServer's per-tag streams): storage
-        # workers then peek only their own stream. Full replication
-        # skips tags — every tag's stream IS the full batch.
-        routed = self._route(batch_mutations)
-        tags = None
-        if self.dd is not None and self.dd.replication < len(self.storages):
-            tags = dict(enumerate(routed))
         # push even empty batches so storage's version advances with cv
         try:
             self.tlog.push(cv, batch_mutations, tags=tags)

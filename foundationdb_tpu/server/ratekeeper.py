@@ -65,6 +65,11 @@ class Ratekeeper:
         ok, _ = self.admit_with_reason(priority, tags)
         return ok
 
+    # Above this target the bucket cannot practically constrain anything
+    # (refill outruns any achievable admission rate), so admission is a
+    # foregone conclusion and the lock is pure hot-path overhead.
+    UNLIMITED_TPS = 1e8
+
     def admit_with_reason(self, priority="default", tags=()):
         """→ (admitted, None | "tag" | "budget"). Tag buckets are
         checked before the global bucket so a throttled tag's denial
@@ -73,6 +78,14 @@ class Ratekeeper:
         observe a rate low enough to be released."""
         if priority == "immediate":
             return True, None  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
+        if (not tags and not self.tag_quotas and not self.tag_limits
+                and self.target_tps >= self.UNLIMITED_TPS):
+            # unconstrained fast path: no tag rules exist and the global
+            # bucket is effectively unbounded — admission cannot fail.
+            # The racy counter only feeds the tagged-share estimate,
+            # which is moot with no tags configured.
+            self._recent_admits += 1
+            return True, None
         with self._mu:
             now = self.clock()
             ok, limited = self._tags_check_locked(tags, now)

@@ -3,9 +3,15 @@
 Ref parity: fdbserver/masterserver.actor.cpp getVersion — hands out
 strictly increasing commit versions, advancing with wall time at
 VERSIONS_PER_SECOND so versions double as a coarse clock (which is what
-makes the 5s MVCC window a *time* window in the reference).
+makes the 5s MVCC window a *time* window in the reference). Grants are
+CHAINED: every grant also names the version granted just before it
+(the reference's GetCommitVersionReply.prevVersion), which is what lets
+a FLEET of commit proxies interleave — each proxy knows exactly which
+version its batch must wait on before resolving/logging, so batches
+from different proxies form one global serial order with no gaps.
 """
 
+import threading
 import time
 
 from foundationdb_tpu.core.versions import VERSIONS_PER_SECOND
@@ -25,6 +31,9 @@ class Sequencer:
         self._last_granted = start_version
         self._epoch = time.monotonic()
         self._start = start_version
+        # concurrent commit proxies request versions from their own
+        # threads; grants must be atomic or two batches could share one
+        self._mu = threading.Lock()
 
     def kill(self):
         """Master death (ref: master failure forcing a full recovery —
@@ -34,15 +43,33 @@ class Sequencer:
     def next_commit_version(self, min_advance=1000):
         """Grant the next batch's commit version (ref: the proxy's
         getVersion request; one version per commit batch)."""
+        return self.next_commit_versions(1, min_advance)[0][1]
+
+    def next_commit_versions(self, k, min_advance=1000):
+        """Grant ``k`` consecutive chained versions atomically: returns
+        [(prev, v), ...] where each ``prev`` is the version granted
+        immediately before ``v`` cluster-wide (ref: getVersion's
+        prevVersion chaining across the proxy fleet). A backlog grabs
+        its whole run in one call so no other proxy's batch lands
+        between its members."""
         if not self.alive:
             raise SequencerDown()
-        if self.version_clock == "wall":
-            wall = self._start + int((time.monotonic() - self._epoch) * VERSIONS_PER_SECOND)
-            v = max(self._last_granted + min_advance, wall)
-        else:
-            v = self._last_granted + min_advance
-        self._last_granted = v
-        return v
+        with self._mu:
+            if not self.alive:  # kill raced the lock
+                raise SequencerDown()
+            out = []
+            for _ in range(k):
+                prev = self._last_granted
+                if self.version_clock == "wall":
+                    wall = self._start + int(
+                        (time.monotonic() - self._epoch) * VERSIONS_PER_SECOND
+                    )
+                    v = max(prev + min_advance, wall)
+                else:
+                    v = prev + min_advance
+                self._last_granted = v
+                out.append((prev, v))
+            return out
 
     def report_committed(self, version):
         """Proxy reports a batch fully committed (tlog-durable)."""

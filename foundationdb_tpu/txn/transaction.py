@@ -132,6 +132,8 @@ class Transaction:
         return self.db._cluster
 
     def _reset(self):
+        knobs = self.db._knobs
+        self._knobs = knobs  # cached: ~3 property hops per op otherwise
         self._read_version = None
         self._writes = WriteMap()
         self._mutation_log = []  # [Mutation] in sequence order
@@ -147,16 +149,32 @@ class Transaction:
         self._lock_aware = False
         self._tags = []  # transaction tags (per-tag throttling)
         self._retry_limit = None
-        self._max_retry_delay = self.db._knobs.max_retry_delay_s
+        self._max_retry_delay = knobs.max_retry_delay_s
         self._timeout_s = None
-        self._backoff = self.db._knobs.initial_backoff_s
+        self._backoff = knobs.initial_backoff_s
         self._retries = 0
         self._size = 0
         self._special_writes = []  # buffered \xff\xff management writes
         self._conflicting_ranges = None  # from a failed reporting commit
         self._watches_pending = []  # [(key, seen_value, Watch-placeholder)]
-        self.options = TransactionOptions(self)
-        self.snapshot = _Snapshot(self)
+        # options/snapshot views are lazy: most transactions never touch
+        # them, and two object constructions per txn is real hot-path cost
+        self._options = None
+        self._snapshot_view = None
+
+    @property
+    def options(self):
+        o = self._options
+        if o is None:
+            o = self._options = TransactionOptions(self)
+        return o
+
+    @property
+    def snapshot(self):
+        s = self._snapshot_view
+        if s is None:
+            s = self._snapshot_view = _Snapshot(self)
+        return s
 
     # ─────────────────────────── versions ─────────────────────────────
     def get_read_version(self):
@@ -196,7 +214,7 @@ class Transaction:
     def get(self, key, snapshot=False):
         self._guard()
         key = _check_key(key)
-        if specialkeys.contains(key):
+        if key.startswith(b"\xff") and specialkeys.contains(key):
             return specialkeys.get(self, key)
         rv = self.get_read_version()
         if not self._ryw_disabled:
@@ -318,13 +336,13 @@ class Transaction:
     def _log_mutation(self, m):
         self._mutation_log.append(m)
         self._size += len(m.key) + len(m.param or b"")
-        if self._size > self.db._knobs.transaction_size_limit:
+        if self._size > self._knobs.transaction_size_limit:
             raise err("transaction_too_large")
 
     def set(self, key, value):
         self._guard()
         key, value = _check_key(key), _check_value(value)
-        if specialkeys.contains(key):
+        if key.startswith(b"\xff") and specialkeys.contains(key):
             specialkeys.write(self, key, value)
             return
         self._writes.set(key, value)
@@ -639,9 +657,11 @@ class _WatchHandle:
 
 
 def _coalesce(ranges):
-    """Sort + merge overlapping conflict ranges (smaller resolver payload)."""
-    if not ranges:
-        return []
+    """Sort + merge overlapping conflict ranges (smaller resolver
+    payload). 0/1-range transactions — the bulk of point traffic —
+    skip the sort entirely."""
+    if len(ranges) <= 1:
+        return list(ranges)
     rs = sorted(ranges)
     out = [list(rs[0])]
     for b, e in rs[1:]:
