@@ -46,6 +46,10 @@ class Knobs:
     # --- proxy batching ---
     commit_batch_interval_s: float = 0.0005
     grv_batch_interval_s: float = 0.0005
+    # fleet VersionGate stall bound: a turn unclaimed this long means a
+    # peer proxy died between grant and advance → 1021 + txn-system
+    # recovery (tests shrink it; see server/proxy.py GateTimeout)
+    gate_timeout_s: float = 60.0
 
     # --- simulation ---
     buggify: bool = False

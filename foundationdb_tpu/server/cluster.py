@@ -247,7 +247,10 @@ class Cluster:
         from foundationdb_tpu.server.proxy import VersionGate
 
         start = self.sequencer.committed_version
-        resolve_gate, log_gate = VersionGate(start), VersionGate(start)
+        t = self.knobs.gate_timeout_s
+        resolve_gate, log_gate = (
+            VersionGate(start, timeout=t), VersionGate(start, timeout=t),
+        )
         inners, members, grvs = [], [], []
         for _ in range(self.n_commit_proxies):
             inner = self._make_commit_proxy(
@@ -611,7 +614,15 @@ class Cluster:
 
         def txn(tr):
             tr.options.set_lock_aware()
-            tr.set(systemdata.DB_LOCKED, uid)
+            # ref: lockDatabase reads databaseLockedKey first — locking
+            # over ANOTHER operator's lock throws 1038 instead of
+            # silently replacing it (same-uid lock is an idempotent
+            # no-op); the read's conflict range serializes racing lockers
+            held = tr.get(systemdata.DB_LOCKED)
+            if held is not None and held != uid:
+                raise err("database_locked")
+            if held is None:
+                tr.set(systemdata.DB_LOCKED, uid)
 
         self.database().run(txn)
         self._commit_target().lock_uid = uid

@@ -20,6 +20,14 @@ from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
 
 
+class GateTimeout(Exception):
+    """A gate turn no one will take (a peer proxy died between its
+    grant and its advance): the fleet is wedged and only a txn-system
+    recovery — which rebuilds the gates — can unwedge it. Callers map
+    this to a retryable 1021 and mark the proxy dead so the failure
+    monitor runs that recovery; it must never escape to a client."""
+
+
 class VersionGate:
     """Version-ordered turnstile for a commit-proxy FLEET (ref: the
     sequencer's prevVersion chaining + the resolvers/tlogs processing
@@ -30,14 +38,18 @@ class VersionGate:
     history; log+storage apply), so proxy B packs and routes while
     proxy A resolves — the fleet pipelines, the state stays serial."""
 
-    def __init__(self, start):
+    def __init__(self, start, timeout=60.0):
         self._v = start
+        self.timeout = timeout
         self._cond = threading.Condition()
 
-    def enter(self, prev, timeout=60.0):
+    def enter(self, prev, timeout=None):
         with self._cond:
-            if not self._cond.wait_for(lambda: self._v >= prev, timeout):
-                raise RuntimeError(
+            if not self._cond.wait_for(
+                lambda: self._v >= prev,
+                self.timeout if timeout is None else timeout,
+            ):
+                raise GateTimeout(
                     f"version gate stuck at {self._v}, waiting for {prev}"
                 )
 
@@ -141,8 +153,22 @@ class CommitProxy:
                 FDBError.from_name("commit_unknown_result")
                 for _ in requests
             ]
-        with self._commit_mu:
-            return self._commit_batch_locked(requests)
+        try:
+            with self._commit_mu:
+                return self._commit_batch_locked(requests)
+        except GateTimeout:
+            return self._gate_wedged(len(requests))
+
+    def _gate_wedged(self, n):
+        """A gate turn went unclaimed (peer died between grant and
+        advance): this generation of the fleet cannot make progress.
+        Mark this proxy dead so the failure monitor's next round runs a
+        txn-system recovery (fresh gates), and answer honest 1021s —
+        the batch's fate is unknown until the new generation fences."""
+        self.kill()
+        return [
+            FDBError.from_name("commit_unknown_result") for _ in range(n)
+        ]
 
     def _partition_rejects(self, requests, reject_fn):
         """Per-request admission gate: ``reject_fn(request)`` returns an
@@ -160,7 +186,13 @@ class CommitProxy:
         if len(passing) == len(requests):
             return None
         if passing:
-            sub = self._commit_batch_locked([r for _, r in passing])
+            try:
+                sub = self._commit_batch_locked([r for _, r in passing])
+            except GateTimeout:
+                # only the sub-batch's fate is unknown: the definitive
+                # rejections already in ``results`` must stand (a known
+                # not-committed must never degrade to maybe-committed)
+                sub = self._gate_wedged(len(passing))
             for (i, _), res in zip(passing, sub):
                 results[i] = res
         return results
@@ -220,16 +252,33 @@ class CommitProxy:
                 for _ in requests
             ]
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
-        txns = self._build_txns(requests)
+        try:
+            txns = self._build_txns(requests)
+        except BaseException:
+            # the grant happened but neither gate was consumed: skip
+            # both turns or every successor waits on a turn no one
+            # will take (advisor r4: a wedged gate never self-heals)
+            self._skip_turns_quiet(prev, cv)
+            raise
         try:
             statuses = self._resolve_ordered(txns, cv, window, prev)
         except ResolverDown:
             # resolution never ran: definitively not committed (1020,
             # retryable without 1021 disambiguation); the failure monitor
             # recruits a fenced replacement resolver. The granted version
-            # still consumes its log turn or the fleet would deadlock.
-            self._skip_turn(self.log_gate, prev, cv)
+            # still consumes its log turn or the fleet would deadlock —
+            # quietly, so a wedged gate cannot replace this KNOWN
+            # outcome with blanket 1021s.
+            self._skip_turns_quiet(prev, cv)
             return [FDBError.from_name("not_committed") for _ in requests]
+        except GateTimeout:
+            raise
+        except BaseException:
+            # _resolve blew up mid-flight: the resolve gate's finally
+            # already advanced (its quiet skip is a no-op), but the
+            # log-gate turn is still owed
+            self._skip_turns_quiet(prev, cv)
+            raise
         return self._finalize_batch(requests, txns, statuses, cv, window,
                                     prev)
 
@@ -256,6 +305,27 @@ class CommitProxy:
             gate.enter(prev)
             gate.advance(cv)
 
+    def _skip_turns_quiet(self, prev, cv):
+        """Skip BOTH gates' turns from inside an exception handler: a
+        wedged gate here must not replace the root-cause exception
+        being propagated (it would be retried as a silent 1021 forever)
+        nor abort before the second gate's skip. The gate damage heals
+        the same way either way — this proxy marks itself dead and the
+        failure monitor's txn-system recovery rebuilds fresh gates.
+        Once one gate proves wedged the rest get a zero wait: the dead
+        peer never advanced either gate, and a second full timeout only
+        delays the root cause (and the recovery's quiesce) for nothing."""
+        wedged = False
+        for gate in (self.resolve_gate, self.log_gate):
+            if gate is None:
+                continue
+            try:
+                gate.enter(prev, timeout=0.0 if wedged else None)
+                gate.advance(cv)
+            except GateTimeout:
+                wedged = True
+                self.kill()
+
     def commit_batches(self, request_batches):
         """Commit a BACKLOG of batches: each gets its own commit version,
         resolution for all of them rides one resolver dispatch
@@ -266,16 +336,29 @@ class CommitProxy:
         if (len(self.resolvers) != 1 or not self.alive
                 or not self.sequencer.alive):
             return [self.commit_batch(reqs) for reqs in request_batches]
-        with self._commit_mu:
-            if getattr(self, "lock_uid", None) is not None:
-                # checked UNDER the mutex: a lock landing while this
-                # backlog queued must fence it exactly as commit_batch
-                # would (the per-batch path re-checks per batch)
-                return [
-                    self._commit_batch_locked(reqs)
-                    for reqs in request_batches
-                ]
-            return self._commit_batches_locked(request_batches)
+        try:
+            with self._commit_mu:
+                if getattr(self, "lock_uid", None) is not None:
+                    # checked UNDER the mutex: a lock landing while this
+                    # backlog queued must fence it exactly as commit_batch
+                    # would (the per-batch path re-checks per batch).
+                    # Results accumulate per batch: a wedge part-way
+                    # through must not turn KNOWN outcomes (durable
+                    # commits, definitive rejections) into 1021s —
+                    # only the unprocessed remainder is unknown.
+                    out = []
+                    try:
+                        for reqs in request_batches:
+                            out.append(self._commit_batch_locked(reqs))
+                    except GateTimeout:
+                        for reqs in request_batches[len(out):]:
+                            out.append(self._gate_wedged(len(reqs)))
+                    return out
+                return self._commit_batches_locked(request_batches)
+        except GateTimeout:
+            return [
+                self._gate_wedged(len(reqs)) for reqs in request_batches
+            ]
 
     def _commit_batches_locked(self, request_batches):
         try:
@@ -289,12 +372,18 @@ class CommitProxy:
                 for reqs in request_batches
             ]
         first_prev, last_cv = pairs[0][0], pairs[-1][1]
-        metas = []
-        for reqs, (prev, cv) in zip(request_batches, pairs):
-            window = max(
-                0, cv - self.knobs.max_read_transaction_life_versions
-            )
-            metas.append((reqs, self._build_txns(reqs), cv, window))
+        try:
+            metas = []
+            for reqs, (prev, cv) in zip(request_batches, pairs):
+                window = max(
+                    0, cv - self.knobs.max_read_transaction_life_versions
+                )
+                metas.append((reqs, self._build_txns(reqs), cv, window))
+        except BaseException:
+            # grant made, gates untouched: consume the whole span's
+            # turns or the rest of the fleet wedges behind it
+            self._skip_turns_quiet(first_prev, last_cv)
+            raise
         if self.resolve_gate is not None:
             self.resolve_gate.enter(first_prev)
         try:
@@ -302,11 +391,17 @@ class CommitProxy:
                 [(txns, cv, window) for _, txns, cv, window in metas]
             )
         except ResolverDown:
-            self._skip_turn(self.log_gate, first_prev, last_cv)
+            self._skip_turns_quiet(first_prev, last_cv)
             return [
                 [FDBError.from_name("not_committed") for _ in reqs]
                 for reqs in request_batches
             ]
+        except BaseException:
+            # resolve_many itself never touches a gate, so anything here
+            # is a resolver-internal root cause: skip the owed log turn
+            # quietly and let IT propagate
+            self._skip_turns_quiet(first_prev, last_cv)
+            raise
         finally:
             if self.resolve_gate is not None:
                 self.resolve_gate.advance(last_cv)
@@ -382,9 +477,10 @@ class CommitProxy:
                 tags = dict(enumerate(routed))
         except BaseException:
             # assembly blew up before the ordered section: the version's
-            # log turn must still be consumed or successors hang
+            # log turn must still be consumed or successors hang (quiet:
+            # the root cause must propagate even if the gate is wedged)
             if prev is not None:
-                self._skip_turn(self.log_gate, prev, cv)
+                self._skip_turns_quiet(prev, cv)
             raise
         if prev is not None and self.log_gate is not None:
             self.log_gate.enter(prev)

@@ -79,11 +79,16 @@ class Ratekeeper:
         if priority == "immediate":
             return True, None  # system txns bypass (ref: TransactionPriority::IMMEDIATE)
         if (not tags and not self.tag_quotas and not self.tag_limits
+                and not self._tag_counts
                 and self.target_tps >= self.UNLIMITED_TPS):
-            # unconstrained fast path: no tag rules exist and the global
-            # bucket is effectively unbounded — admission cannot fail.
-            # The racy counter only feeds the tagged-share estimate,
-            # which is moot with no tags configured.
+            # unconstrained fast path: no tag rules exist, no tagged
+            # traffic has been sampled, and the global bucket is
+            # effectively unbounded — admission cannot fail. The racy
+            # counter only feeds the tagged-share estimate; requiring an
+            # empty _tag_counts keeps untagged increments from racing
+            # (and shrinking) the admissions base while tagged txns take
+            # the locked path, which would bias TOWARD spurious
+            # auto-throttling.
             self._recent_admits += 1
             return True, None
         with self._mu:

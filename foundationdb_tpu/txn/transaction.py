@@ -513,17 +513,26 @@ class Transaction:
                 result, "conflicting_key_ranges", None
             )
             raise result
+        # the data half is durable regardless of what the management
+        # half does below: record it first so the client can always
+        # observe what committed (mixed transactions are not atomic)
+        self._committed_version = result
+        self._versionstamp = Versionstamp.from_version(result).tr_version
         try:
             specialkeys.commit_special(self)
         except FDBError as e:
-            if e.description != "database_locked":
-                raise
-            from foundationdb_tpu.utils.trace import TraceEvent
+            if e.description == "database_locked" and not self._lock_aware:
+                from foundationdb_tpu.utils.trace import TraceEvent
 
-            TraceEvent("ManagementWritesFencedByLock", severity=30).detail(
-                committed_version=result).log()
-        self._committed_version = result
-        self._versionstamp = Versionstamp.from_version(result).tr_version
+                TraceEvent("ManagementWritesFencedByLock",
+                           severity=30).detail(
+                    committed_version=result).log()
+            else:
+                # a genuine management failure (a lock-AWARE txn is
+                # never fenced by the lock — e.g. locking over another
+                # operator's uid raises its own 1038): surface it
+                self._state = "error"
+                raise
         self._state = "committed"
         self._activate_watches()
 

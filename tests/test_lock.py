@@ -146,6 +146,41 @@ def test_mixed_data_management_txn_checks_lock_before_commit(db):
     assert db.run(lambda tr: tr.get(b"data-key")) is None
 
 
+def test_lock_over_foreign_uid_raises_1038(db):
+    """Ref: ManagementAPI lockDatabase reads databaseLockedKey first —
+    a second operator's lock attempt fails 1038 instead of silently
+    replacing the first; re-locking with the SAME uid is a no-op."""
+    db._cluster.lock_database(b"op-A")
+    with pytest.raises(FDBError) as ei:
+        db._cluster.lock_database(b"op-B")
+    assert ei.value.code == 1038
+    assert db._cluster.lock_uid() == b"op-A"  # first lock stands
+    db._cluster.lock_database(b"op-A")  # idempotent
+    db._cluster.unlock_database()
+    db._cluster.lock_database(b"op-B")  # now free
+    assert db._cluster.lock_uid() == b"op-B"
+    db._cluster.unlock_database()
+
+
+def test_mixed_lockaware_txn_surfaces_management_1038(db):
+    """A lock-AWARE mixed txn is never fenced by the lock, so a 1038
+    from its management half (locking over a foreign uid) must surface
+    instead of being swallowed by the fence-race handler — while the
+    already-durable data half stays observable."""
+    db._cluster.lock_database(b"op-A")
+    tr = db.create_transaction()
+    tr.options.set_lock_aware()
+    tr[b"data-key"] = b"v"
+    tr.set(specialkeys.DB_LOCKED, b"op-B")  # foreign-uid lock attempt
+    with pytest.raises(FDBError) as ei:
+        tr.commit()
+    assert ei.value.code == 1038
+    assert db._cluster.lock_uid() == b"op-A"  # lock NOT replaced
+    assert tr.get_committed_version() > 0  # data half durable, visible
+    db._cluster.unlock_database()
+    assert db[b"data-key"] == b"v"
+
+
 def test_lock_survives_wal_recovery(tmp_path):
     """The lock uid persists as the \\xff/dbLocked system row (ref:
     databaseLockedKey) — a cluster restart recovers a LOCKED database,
