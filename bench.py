@@ -20,9 +20,15 @@ finishes) all cancel or are bypassed. The chained-dispatch estimate
 rides along as ``conflict_check_dispatch_*`` for transparency.
 
 One default run prints ONE JSON line PER BASELINE CONFIG (range-heavy
-kernel, mako / tpcc / sharded-resolver / local-native e2e) with the
-YCSB-A point headline LAST — the driver parses the final line; the
-others ride the stdout tail. BENCH_MODE=point / range runs a single
+kernel, mako / tpcc / sharded-resolver / fleet / local-native e2e),
+then the rich YCSB-A point headline, then a COMPACT summary line LAST —
+the driver parses the final line from a bounded (~2KB) stdout-tail
+capture, so the last line is guaranteed small (VERDICT r4: the folded
+rich headline overran the tail and parsed as null) with the headline
+metric/value/vs_baseline fields at the very END of the object. If the
+initial TPU probe fell back to CPU, the chip is RE-probed between
+configs and the kernel configs re-exec in a fresh TPU subprocess when
+the tunnel recovers late. BENCH_MODE=point / range runs a single
 config the old way.
 """
 
@@ -37,14 +43,16 @@ import numpy as np
 BASELINE_TXNS_PER_SEC = 1_000_000  # the target the reference design is held to
 
 
-def _probe_backend(timeout_s):
+def _probe_backend(timeout_s, env=None):
     """Probe JAX backend init in a throwaway subprocess.
 
     Backend bring-up on this image is flaky in BOTH directions: round 1's
     driver run died with "Unable to initialize backend 'axon'" (rc=1), and
     the same call can also HANG indefinitely when the TPU tunnel is
     wedged. A subprocess probe converts both failure modes into a
-    (platform|None, error) result the parent can act on.
+    (platform|None, error) result the parent can act on. ``env`` lets
+    the between-config recovery probe bypass the parent's own
+    JAX_PLATFORMS=cpu fallback pin.
     """
     import subprocess
 
@@ -53,7 +61,7 @@ def _probe_backend(timeout_s):
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
-            env=os.environ.copy(),
+            env=os.environ.copy() if env is None else env,
         )
         if r.returncode == 0 and r.stdout.strip():
             return r.stdout.strip().splitlines()[-1], None
@@ -105,16 +113,22 @@ def _init_platform():
     # BENCH_REQUIRE_PLATFORM opt-in suppresses the CPU fallback.
     if os.environ.get("BENCH_REQUIRE_PLATFORM"):
         raise RuntimeError(f"required platform ({want}) never came up: {last}")
+    # stash what the operator/image originally asked for, so the
+    # between-config recovery probe can re-try the device platform even
+    # though this process now pins itself to CPU
+    os.environ["BENCH_ORIG_JAX_PLATFORMS"] = want
     os.environ["JAX_PLATFORMS"] = "cpu"
     _force_cpu_if_requested()
     return "cpu", str(last) or "backend probe failed with no output"
 
 
-def _start_watchdog():
+def _start_watchdog(extra_s=0):
     """A successful probe doesn't guarantee the parent's own backend init
     or device work won't wedge (the TPU tunnel can die between the two).
     A daemon-thread deadline converts any later hang into the same
     parseable bench_error line + nonzero exit the except path produces.
+    ``extra_s`` widens the deadline when the run plans extra
+    subprocess-bounded work (the between-config TPU recovery re-execs).
     """
     import threading
 
@@ -123,7 +137,7 @@ def _start_watchdog():
     # varies ~3x: 1200s left no margin on bad-tunnel days (observed
     # overrun); 2100s keeps the hang-vs-slow distinction while covering
     # the measured worst case with headroom
-    deadline_s = float(os.environ.get("BENCH_WATCHDOG_S", 2100))
+    deadline_s = float(os.environ.get("BENCH_WATCHDOG_S", 2100)) + extra_s
     lock = threading.Lock()
     state = {"done": False}
 
@@ -441,7 +455,8 @@ def measure_kernel_step_ms(ck, params, batch, n_short=8, n_long=40,
     return float(np.median(est))
 
 
-def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None):
+def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
+            n_proxies=None):
     """End-to-end committed txns/sec: N client threads driving pipelined
     commits through the full live pipeline — Transaction → batching
     commit proxy (shared-version batches) → TPU resolver → tlog →
@@ -476,8 +491,11 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None):
     # host-pipeline scaling (VERDICT r3 do#2): the link-free local
     # config runs a commit-proxy FLEET by default; device-backed
     # configs keep one proxy (the shared device serializes anyway)
-    n_proxies = int(env("BENCH_E2E_PROXIES",
-                        2 if backend in ("native", "cpu") else 1))
+    # unless the caller forces a fleet (the fleet-on config measures
+    # what the gates cost on a shared chip — VERDICT r4 do#7)
+    if n_proxies is None:
+        n_proxies = int(env("BENCH_E2E_PROXIES",
+                            2 if backend in ("native", "cpu") else 1))
     cluster = Cluster(
         commit_pipeline="thread",
         resolver_backend=backend,
@@ -1006,11 +1024,95 @@ def run_ring_capacity_probe(cpu):
     return out
 
 
+def _device_env():
+    """A child env that asks for the ORIGINAL (device) platform again,
+    undoing this process's own CPU fallback pin."""
+    env2 = os.environ.copy()
+    orig = env2.pop("BENCH_ORIG_JAX_PLATFORMS", None)
+    if orig:
+        env2["JAX_PLATFORMS"] = orig
+    else:
+        env2.pop("JAX_PLATFORMS", None)  # let the plugin claim the chip
+    return env2
+
+
+def _reexec_kernel_tpu(point, timeout_s):
+    """Run one kernel config in a fresh subprocess against a recovered
+    TPU backend. The parent already pinned itself to CPU — JAX backends
+    are per-process — so a tunnel that came back after the initial
+    probe window can only be used by a child. Returns the child's
+    parsed JSON line when it really ran on a device (never a silent
+    second CPU number), else None."""
+    import subprocess
+
+    env2 = _device_env()
+    env2["BENCH_MODE"] = "point" if point else "range"
+    env2["BENCH_E2E"] = "0"
+    env2["BENCH_REQUIRE_PLATFORM"] = "1"  # child must not CPU-fall-back
+    env2["BENCH_PROBE_BUDGET_S"] = "90"   # the chip just probed up
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env2,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("tpu re-exec timed out\n")
+        return None
+    for ln in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if parsed.get("value") and parsed.get("platform") not in (None,
+                                                                  "cpu"):
+            return parsed
+    sys.stderr.write(
+        f"tpu re-exec produced no device line (rc={r.returncode}): "
+        f"{(r.stderr or r.stdout)[-300:]}\n")
+    return None
+
+
+def _compact_summary(out, configs):
+    """The FINAL stdout line, guaranteed to fit the driver's ~2KB
+    stdout-tail capture (VERDICT r4 weak #1: the folded rich headline
+    overran it and the round's number parsed as null). One number per
+    config; the headline metric/value/vs_baseline sit at the very END
+    of the object so even a mid-line cut leaves them in the tail
+    (json.dumps preserves insertion order)."""
+    cfg = {}
+    for name, c in configs.items():
+        if "error" in c:
+            cfg[name] = "error"
+        elif name == "ring_capacity":
+            cfg[name] = c.get("speedup_partitioned")
+        else:
+            cfg[name] = c.get("value")
+    line = {"summary": True, "unit": out.get("unit", "txns/sec")}
+    for k in ("platform", "device_kernel_txns_per_sec",
+              "conflict_check_p99_ms", "kernel_step_ms",
+              "pallas_kernel_step", "e2e_committed_txns_per_sec",
+              "e2e_proxies", "e2e_conflict_rate", "tpu_recovered",
+              "fallback_from", "error"):
+        if out.get(k) is not None:
+            line[k] = out[k]
+    line["configs"] = cfg
+    line["metric"] = out["metric"]
+    line["value"] = out["value"]
+    line["vs_baseline"] = out["vs_baseline"]
+    if len(json.dumps(line)) > 1900:  # belt and braces: keep the headline
+        line.pop("configs", None)
+        for k in ("fallback_from", "error"):
+            if k in line and isinstance(line[k], str):
+                line[k] = line[k][:100]
+    return line
+
+
 def main():
     # probe first (subprocess-bounded, cannot hang), THEN arm the
-    # watchdog — the full deadline belongs to the bench itself
+    # watchdog — the full deadline belongs to the bench itself. A
+    # CPU-fallback run plans extra subprocess-bounded recovery re-execs
+    # (below), so its deadline widens to cover them.
     platform, fallback_note = _init_platform()
-    watchdog_finish = _start_watchdog()
     env = os.environ.get
     # CPU shapes are scaled down: the interpreter-hosted backend is ~100x
     # slower per slot, and the full TPU config (8M-slot hash table, 8k-txn
@@ -1018,6 +1120,12 @@ def main():
     cpu = platform == "cpu"
     mode = env("BENCH_MODE", "all")  # all | point | range |
     # ring_capacity | sharded_e2e (internal: the multilane re-exec child)
+    # only the default multi-config run plans recovery re-execs, so only
+    # it earns the wider deadline (worst case 60+500+120+650s of
+    # subprocess-bounded recovery work)
+    watchdog_finish = _start_watchdog(
+        extra_s=1300 if fallback_note is not None and mode == "all" else 0
+    )
 
     if mode == "sharded_e2e":
         # child of _run_sharded_multilane: exactly one sharded e2e line
@@ -1069,21 +1177,56 @@ def main():
             configs[name]["error"] = line["error"]
 
     E2E_KEYS = ("platform", "e2e_backend", "e2e_mode", "e2e_resolver_lanes",
-                "e2e_conflict_rate", "e2e_aborted_txns", "e2e_backlog_target")
-    try:
-        rng_out = run_kernel_bench(False, cpu, fallback_note)
-        rng_out["metric"] = "resolved_txns_per_sec_range_heavy_zipfian99"
+                "e2e_proxies", "e2e_conflict_rate", "e2e_aborted_txns",
+                "e2e_backlog_target")
+
+    # Between-config TPU recovery (VERDICT r4 do#1b): a tunnel that was
+    # wedged at t=0 sometimes comes back minutes later — when the run
+    # CPU-fell-back, quickly re-probe the chip before each kernel config
+    # and re-exec that config in a fresh TPU subprocess on recovery, so
+    # a late-recovering chip still yields driver-verified TPU numbers.
+    recovery = {"up": False, "attempts": 0}
+
+    def _tpu_recovered(probe_s):
+        if not cpu or fallback_note is None:
+            return False
+        if recovery["up"]:
+            return True
+        if recovery["attempts"] >= 2:
+            return False
+        recovery["attempts"] += 1
+        p, _ = _probe_backend(probe_s, env=_device_env())
+        recovery["up"] = bool(p and p != "cpu")
+        if recovery["up"]:
+            sys.stderr.write("tpu tunnel recovered between configs\n")
+        return recovery["up"]
+
+    rng_out = None
+    if _tpu_recovered(60):
+        rng_out = _reexec_kernel_tpu(point=False, timeout_s=500)
+        if rng_out is not None:
+            rng_out["tpu_recovered"] = True
+    if rng_out is not None:
         _emit(rng_out)
         _fold("range", rng_out,
               ("platform", "device_kernel_txns_per_sec", "kernel_step_ms",
                "pallas_scan", "batch_size"))
-    except Exception as e:
-        sys.stderr.write(f"range config failed: {type(e).__name__}: {e}\n")
-        line = {"metric": "resolved_txns_per_sec_range_heavy_zipfian99",
-                "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
-                "error": f"{type(e).__name__}: {e}"[:200]}
-        _emit(line)
-        _fold("range", line, ())
+    else:
+        try:
+            rng_out = run_kernel_bench(False, cpu, fallback_note)
+            rng_out["metric"] = "resolved_txns_per_sec_range_heavy_zipfian99"
+            _emit(rng_out)
+            _fold("range", rng_out,
+                  ("platform", "device_kernel_txns_per_sec",
+                   "kernel_step_ms", "pallas_scan", "batch_size"))
+        except Exception as e:
+            sys.stderr.write(
+                f"range config failed: {type(e).__name__}: {e}\n")
+            line = {"metric": "resolved_txns_per_sec_range_heavy_zipfian99",
+                    "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+            _emit(line)
+            _fold("range", line, ())
 
     if env("BENCH_RINGCAP", "1") != "0":
         try:
@@ -1097,16 +1240,23 @@ def main():
     # the headline must be the LAST line even if this config dies (a
     # driver parsing the stdout tail must never mistake the range line
     # for the YCSB-A headline)
-    try:
-        out = run_kernel_bench(True, cpu, fallback_note)
-    except Exception as e:
-        sys.stderr.write(f"point config failed: {type(e).__name__}: {e}\n")
-        watchdog_finish()
-        _emit({"metric": "resolved_txns_per_sec_ycsb_a_zipfian99",
-               "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
-               "configs": configs,
-               "error": f"{type(e).__name__}: {e}"[:500]})
-        sys.exit(1)
+    out = None
+    if _tpu_recovered(120):
+        out = _reexec_kernel_tpu(point=True, timeout_s=650)
+        if out is not None:
+            out["tpu_recovered"] = True
+    if out is None:
+        try:
+            out = run_kernel_bench(True, cpu, fallback_note)
+        except Exception as e:
+            sys.stderr.write(
+                f"point config failed: {type(e).__name__}: {e}\n")
+            watchdog_finish()
+            err_out = {"metric": "resolved_txns_per_sec_ycsb_a_zipfian99",
+                       "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
+                       "error": f"{type(e).__name__}: {e}"[:300]}
+            _emit(_compact_summary(err_out, configs))
+            sys.exit(1)
 
     if env("BENCH_E2E", "1") != "0":
         secondary_s = float(env("BENCH_E2E_SECONDS_SECONDARY",
@@ -1133,15 +1283,32 @@ def main():
         _fold("local", _e2e_line(cpu, "e2e_committed_txns_per_sec_local",
                                  backend="native", fallback_backend="cpu",
                                  seconds=secondary_s), E2E_KEYS)
+        # fleet-on headline variant (VERDICT r4 do#7): the device-backed
+        # e2e with a 2-proxy fleet, so the artifact records what the
+        # VersionGates cost on a shared chip
+        _fold("fleet", _e2e_line(cpu, "e2e_committed_txns_per_sec_fleet",
+                                 n_proxies=2, seconds=secondary_s),
+              E2E_KEYS)
         # the headline e2e (attached to the final line, as in round 2)
         try:
-            out.update(run_e2e(cpu))
+            e2e = run_e2e(cpu)
+            if out.get("platform") and \
+                    e2e.get("platform") != out["platform"]:
+                # the kernel number came from a recovered-TPU child; the
+                # e2e ran in this (CPU-pinned) process — keep both
+                # platforms honest instead of clobbering the kernel's
+                e2e["e2e_platform"] = e2e.pop("platform")
+            out.update(e2e)
         except Exception as e:
             sys.stderr.write(f"e2e bench failed: {type(e).__name__}: {e}\n")
             out["e2e_error"] = f"{type(e).__name__}: {e}"[:200]
     out["configs"] = configs
     watchdog_finish()
+    # the rich headline (full detail, for humans reading the log) …
     _emit(out)
+    # … then the guaranteed-small summary as the very last line — the
+    # only line the driver's bounded tail capture must parse
+    _emit(_compact_summary(out, configs))
 
 
 if __name__ == "__main__":

@@ -295,26 +295,21 @@ class CommitProxy:
             # advance even on failure: the version is consumed either way
             self.resolve_gate.advance(cv)
 
-    @staticmethod
-    def _skip_turn(gate, prev, cv):
-        """Consume a granted version's turn at ``gate`` without doing
-        its work (failed batch): successors must never wait on a turn
-        no one will take. Still waits for order — advancing early would
-        let a LATER version pass before an EARLIER one logged."""
-        if gate is not None:
-            gate.enter(prev)
-            gate.advance(cv)
-
     def _skip_turns_quiet(self, prev, cv):
-        """Skip BOTH gates' turns from inside an exception handler: a
-        wedged gate here must not replace the root-cause exception
-        being propagated (it would be retried as a silent 1021 forever)
-        nor abort before the second gate's skip. The gate damage heals
-        the same way either way — this proxy marks itself dead and the
-        failure monitor's txn-system recovery rebuilds fresh gates.
-        Once one gate proves wedged the rest get a zero wait: the dead
-        peer never advanced either gate, and a second full timeout only
-        delays the root cause (and the recovery's quiesce) for nothing."""
+        """Consume a failed batch's turns at BOTH gates without doing
+        its work: successors must never wait on a turn no one will
+        take. Each skip still waits for order (advancing early would
+        let a LATER version pass before an EARLIER one logged), but
+        QUIETLY — called from failure handlers, a wedged gate must not
+        replace the outcome being propagated (a definitive 1020, or a
+        root-cause exception that would otherwise be retried as a
+        silent 1021 forever) nor abort before the second gate's skip.
+        The gate damage heals the same way either way — this proxy
+        marks itself dead and the failure monitor's txn-system recovery
+        rebuilds fresh gates. Once one gate proves wedged the rest get
+        a zero wait: the dead peer never advanced either gate, and a
+        second full timeout only delays the root cause (and the
+        recovery's quiesce) for nothing."""
         wedged = False
         for gate in (self.resolve_gate, self.log_gate):
             if gate is None:

@@ -241,7 +241,7 @@ def test_tenant_mode_fans_out_to_every_member(fleet_cluster):
 
 def test_resolver_death_skips_log_turn_peers_continue(fleet_cluster):
     """ResolverDown mid-fleet: the batch answers 1020, its log-gate
-    turn is consumed (_skip_turn), and after recruitment the OTHER
+    turn is consumed (_skip_turns_quiet), and after recruitment the OTHER
     members commit without wedging behind the dead batch's version."""
     c = fleet_cluster
     _commit(c, c.commit_proxy.inners[0], [(b"a", b"1")])
