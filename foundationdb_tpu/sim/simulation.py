@@ -104,7 +104,7 @@ class Simulation:
     def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
                  datadir=None, engine="memory", **cluster_kwargs):
         self.seed = seed
-        self.engine_kind = engine  # "memory" | "versioned" | "sqlite"
+        self.engine_kind = engine  # "memory" | "versioned" | "redwood" | "sqlite"
         self.rng = random.Random(seed)
         self.buggify = Buggify(seed=seed, enabled=buggify)
         self.crash_p = crash_p

@@ -49,6 +49,17 @@ def build_cluster(args, coordination=None):
         kw["wal_path"] = os.path.join(args.dir, "tlog.wal")
         if coordination is None:
             kw["coordination_dir"] = os.path.join(args.dir, "coordination")
+    engine = getattr(args, "storage_engine", None)
+    if engine:
+        from foundationdb_tpu.server.kvstore import open_engine
+
+        if not args.dir and engine != "memory":
+            raise SystemExit(f"--storage-engine {engine} requires --dir")
+        base = os.path.join(args.dir, "store") if args.dir else None
+        kw["storage_engines"] = [
+            open_engine(engine, None if base is None else f"{base}.{i}")
+            for i in range(args.storage)
+        ]
     return Cluster(
         n_storage=args.storage,
         n_resolvers=args.resolvers,
@@ -83,6 +94,12 @@ def main(argv=None):
                         "log stream and serve only its owned ranges "
                         "(tag-partitioned log; default: full stream)")
     p.add_argument("--storage", type=int, default=1)
+    p.add_argument("--storage-engine", default=None,
+                   choices=["memory", "sqlite", "versioned", "redwood"],
+                   help="persistent engine beneath each storage server "
+                        "(ref: `configure ssd|memory`; redwood = the "
+                        "disk-resident versioned engine; disk kinds "
+                        "need --dir)")
     p.add_argument("--resolvers", type=int, default=1)
     p.add_argument("--tlogs", type=int, default=1)
     p.add_argument("--replication", type=int, default=None)

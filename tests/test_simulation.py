@@ -231,3 +231,17 @@ def test_mako_load_mix_under_faults(tmp_path):
     assert stats["txns"] == 75
     assert {"get", "set", "getrange", "update", "clearrange"} <= set(stats)
     sim.close()
+
+
+def test_cycle_on_redwood_disk_engine_under_faults(tmp_path):
+    """The DISK-resident Redwood-role engine under the same fault
+    battery: crash/recovery resumes from sqlite's committed version and
+    sub-durable reads serve from the on-disk chains (ref: simulation
+    covering every storage engine type)."""
+    recoveries = 0
+    for seed in (5, 6):
+        with _run_cycle_sim(seed, tmp_path, engine="redwood",
+                            crash_p=0.01) as sim:
+            recoveries += sim.recoveries
+            assert sim.cluster.storage.versioned_engine
+    assert recoveries > 0, "no crash/recovery exercised on redwood engine"
