@@ -11,11 +11,11 @@ with it JAX) into their import graph.
 class CommitRequest:
     __slots__ = ("read_version", "mutations", "read_conflict_ranges",
                  "write_conflict_ranges", "report_conflicting_keys",
-                 "lock_aware")
+                 "lock_aware", "idempotency_id")
 
     def __init__(self, read_version, mutations, read_conflict_ranges,
                  write_conflict_ranges, report_conflicting_keys=False,
-                 lock_aware=False):
+                 lock_aware=False, idempotency_id=None):
         self.read_version = read_version
         self.mutations = mutations
         self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
@@ -24,3 +24,8 @@ class CommitRequest:
         # ref: FDBTransactionOptions LOCK_AWARE — this txn commits even
         # while the database is locked (lockDatabase in ManagementAPI)
         self.lock_aware = lock_aware
+        # ref: fdbclient/IdempotencyId.actor.cpp — a client-chosen token
+        # carried with the commit; the proxy records it atomically with
+        # the mutations and dedupes resubmissions, so a retry after 1021
+        # cannot double-apply
+        self.idempotency_id = idempotency_id

@@ -17,6 +17,30 @@ CONF_REPLICATION = b"\xff/conf/replication"
 # persisted so the lock survives recovery and rides the DR seed/stream.
 DB_LOCKED = b"\xff/dbLocked"
 
+# Commit idempotency ids (ref: fdbclient/IdempotencyId.actor.cpp — the
+# idempotencyIdKeys range): one row per recently committed idempotent
+# transaction, id → commit version. Written atomically WITH the commit's
+# mutations, so the row's presence at any later read version proves the
+# commit applied; the proxy GCs rows older than the MVCC window.
+IDMP_PREFIX = b"\xff\x02/idmp/"
+IDMP_END = b"\xff\x02/idmp0"
+
+
+def idmp_key(idempotency_id):
+    return IDMP_PREFIX + idempotency_id
+
+
+def pack_version(v):
+    import struct
+
+    return struct.pack(">q", v)
+
+
+def unpack_version(b):
+    import struct
+
+    return struct.unpack(">q", b)[0]
+
 
 def encode_shard_map(shard_map):
     """ShardMap → [(key, value)] rows: one row per shard, keyed by its

@@ -18,7 +18,7 @@ from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.core.mutations import Mutation, Op
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3  # v3: CommitRequest carries idempotency_id
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -91,6 +91,7 @@ def _enc(buf, v):
         _enc(buf, [(bytes(b_), bytes(e_)) for b_, e_ in v.write_conflict_ranges])
         buf.append(b"T" if v.report_conflicting_keys else b"F")
         buf.append(b"T" if v.lock_aware else b"F")
+        _enc(buf, v.idempotency_id)
     elif isinstance(v, FDBError):
         buf.append(b"e")
         buf.append(struct.pack(">I", v.code))
@@ -169,7 +170,9 @@ def _dec(r: _Reader):
         wcr = _dec(r)
         report = r.take(1) == b"T"
         lock_aware = r.take(1) == b"T"
-        return CommitRequest(rv, muts, rcr, wcr, report, lock_aware)
+        idmp = _dec(r)
+        return CommitRequest(rv, muts, rcr, wcr, report, lock_aware,
+                             idempotency_id=idmp)
     if tag == b"e":
         (code,) = struct.unpack(">I", r.take(4))
         e = FDBError(code)

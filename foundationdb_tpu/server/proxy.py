@@ -12,7 +12,9 @@ import threading
 
 from foundationdb_tpu.core.commit import CommitRequest  # noqa: F401  (re-export)
 from foundationdb_tpu.core.errors import FDBError, err
-from foundationdb_tpu.core.mutations import Op, substitute_versionstamp
+from foundationdb_tpu.core.mutations import (
+    Mutation, Op, substitute_versionstamp,
+)
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
 from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
@@ -187,7 +189,9 @@ class CommitProxy:
             return None
         if passing:
             try:
-                sub = self._commit_batch_locked([r for _, r in passing])
+                # sub-batches re-enter past the dedupe: their requests
+                # already passed it this very call
+                sub = self._commit_batch_admitted([r for _, r in passing])
             except GateTimeout:
                 # only the sub-batch's fate is unknown: the definitive
                 # rejections already in ``results`` must stand (a known
@@ -220,7 +224,54 @@ class CommitProxy:
                 return "tenants_disabled"
         return None
 
+    def _idmp_lookup(self, idempotency_id):
+        """The committed version recorded for ``idempotency_id``, or
+        None. Read from any live storage's system keyspace (replicated
+        everywhere) at its latest version — every earlier commit through
+        this serialized pipeline is visible there."""
+        from foundationdb_tpu.core import systemdata
+
+        key = systemdata.idmp_key(idempotency_id)
+        for s in self.storages:
+            if s.alive:
+                row = s.get(key, s.version)
+                return None if row is None else \
+                    systemdata.unpack_version(row)
+        return None
+
+    def _dedupe_idempotent(self, requests):
+        """Proxy-side exactly-once (ref: IdempotencyId — ours is checked
+        AT the proxy, which closes the client-check's resubmit race:
+        commits serialize through this pipeline, so by the time a retry
+        runs, its original either applied — id row visible — or never
+        will). Returns merged results, or None when nothing matched."""
+        results = [None] * len(requests)
+        passing = []
+        for i, r in enumerate(requests):
+            v = (self._idmp_lookup(r.idempotency_id)
+                 if getattr(r, "idempotency_id", None) else None)
+            if v is None:
+                passing.append((i, r))
+            else:
+                results[i] = v  # the ORIGINAL commit's version: success
+        if len(passing) == len(requests):
+            return None
+        if passing:
+            sub = self._commit_batch_admitted([r for _, r in passing])
+            for (i, _), res in zip(passing, sub):
+                results[i] = res
+        return results
+
     def _commit_batch_locked(self, requests):
+        if any(getattr(r, "idempotency_id", None) for r in requests):
+            out = self._dedupe_idempotent(requests)
+            if out is not None:
+                return out
+        return self._commit_batch_admitted(requests)
+
+    def _commit_batch_admitted(self, requests):
+        """The batch pipeline past the idempotency dedupe (every entry
+        route runs the dedupe exactly once before landing here)."""
         lock_uid = getattr(self, "lock_uid", None)
         if lock_uid is not None:
             # database locked (ref: lockDatabase / error 1038): only
@@ -356,6 +407,23 @@ class CommitProxy:
             ]
 
     def _commit_batches_locked(self, request_batches):
+        # the pipelined backlog must dedupe too — 1021 retries are MOST
+        # likely to arrive on exactly this throughput path. Any matched
+        # id drops the backlog to the per-batch route, whose dedupe
+        # answers the duplicate its original version.
+        if any(getattr(r, "idempotency_id", None)
+               and self._idmp_lookup(r.idempotency_id) is not None
+               for reqs in request_batches for r in reqs):
+            out = []
+            try:
+                for reqs in request_batches:
+                    out.append(self._commit_batch_locked(reqs))
+            except GateTimeout:
+                # known per-batch outcomes stand; only the remainder is
+                # unknown (same contract as the locked-backlog branch)
+                for reqs in request_batches[len(out):]:
+                    out.append(self._gate_wedged(len(reqs)))
+            return out
         try:
             # the whole backlog's versions in ONE chained grant: no other
             # proxy's batch can land inside this run, so the backlog is
@@ -438,6 +506,8 @@ class CommitProxy:
             results = []
             batch_mutations = []
             batch_conflicts = 0
+            from foundationdb_tpu.core import systemdata
+
             for i, (req, st) in enumerate(zip(requests, statuses)):
                 if st == COMMITTED:
                     muts = [
@@ -447,6 +517,16 @@ class CommitProxy:
                         for m in req.mutations
                     ]
                     batch_mutations.extend(muts)
+                    if getattr(req, "idempotency_id", None):
+                        # the id row commits ATOMICALLY with the txn's
+                        # mutations — its presence at any later read
+                        # version proves this commit applied (ref:
+                        # idempotencyIdKeys written in the same batch)
+                        batch_mutations.append(Mutation(
+                            Op.SET,
+                            systemdata.idmp_key(req.idempotency_id),
+                            systemdata.pack_version(cv),
+                        ))
                     results.append(cv)
                 elif st == TOO_OLD:
                     results.append(FDBError.from_name("transaction_too_old"))
@@ -459,6 +539,19 @@ class CommitProxy:
                         )
                     results.append(e)
                     batch_conflicts += 1
+
+            # expired-id GC rides an ordinary batch (same durability /
+            # replication / DR path as the rows themselves): every
+            # pump_interval batches, clear ids older than RETENTION —
+            # a deliberate multiple of the MVCC window, because a 1021
+            # retry carries a FRESH read version and can arrive long
+            # after the original's window closed (ref: the idempotency
+            # id cleaner retaining ids by AGE, far past the window).
+            # Runs on the next batch AFTER the pump, capped per round.
+            if self._batches_since_pump == 0 and self.commit_count:
+                horizon = max(0, cv - self.IDMP_RETENTION_WINDOWS *
+                              self.knobs.max_read_transaction_life_versions)
+                batch_mutations.extend(self._idmp_expired(horizon))
 
             # Route BEFORE the push so the log stores the per-tag split
             # (ref: applyMetadataToCommittedTransactions tagging mutations
@@ -563,6 +656,29 @@ class CommitProxy:
         if exact:
             return sorted(set(ranges))
         return sorted(set(txn.read_ranges()))
+
+    # id rows outlive the MVCC window by this factor (~50s at the
+    # default 5s window): the slack a delayed retry has to arrive and
+    # still dedupe instead of double-applying
+    IDMP_RETENTION_WINDOWS = 10
+
+    def _idmp_expired(self, horizon, cap=1000):
+        """CLEAR mutations for idempotency-id rows whose commit version
+        fell below the retention horizon (scanned from a live storage's
+        system keyspace; empty scan when no idempotent traffic)."""
+        from foundationdb_tpu.core import systemdata
+
+        live = next((s for s in self.storages if s.alive), None)
+        if live is None:
+            return []
+        out = []
+        for k, v in live.read_range(systemdata.IDMP_PREFIX,
+                                    systemdata.IDMP_END, live.version):
+            if systemdata.unpack_version(v) < horizon:
+                out.append(Mutation(Op.CLEAR_RANGE, k, k + b"\x00"))
+                if len(out) >= cap:
+                    break
+        return out
 
     def _pump_durability(self, window):
         """Periodic updateStorage analog: fold versions that left the MVCC

@@ -56,10 +56,11 @@ def cycle_setup(db, n_nodes, prefix=b"cycle/"):
 
 def cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
     """Pointer-rotation transactions: read r→a→b→c, relink to r→b→a→c.
-    Every committed (or half-committed — there are none, commits are
-    atomic) state is a single n-cycle, so the invariant survives
-    commit_unknown_result without idempotency tricks — exactly why the
-    reference uses this shape under fault injection."""
+    Every committed state is a single n-cycle, so the invariant is
+    insensitive to how commit_unknown_result is disambiguated (the
+    reference uses this shape under fault injection for the same
+    reason); counter_workload below is the complementary shape whose
+    invariant REQUIRES the idempotency-id machinery for exactly-once."""
     key = lambda i: prefix + _enc(i)
     for _ in range(n_ops):
         r = rng.randrange(n_nodes)
@@ -73,6 +74,34 @@ def cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):
             tr.set(key(b), _enc(a))
 
         yield from run_txn(db, fn)
+
+
+def counter_workload(db, n_ops, stats, key=b"idmp/counter"):
+    """Increment-by-one RMW transactions under AUTOMATIC_IDEMPOTENCY
+    (ref: the AtomicOps workload shape + IdempotencyId.actor.cpp): the
+    counter's final value must equal the increments REPORTED committed —
+    the invariant the cycle shape cannot see, because a 1021 retry that
+    double-applies still leaves a valid cycle but inflates a counter.
+    The runner retries 1021 like a real client (tr.on_error): the id
+    machinery — the id row committed atomically with the mutations, the
+    client's id-row check, and the proxy's serialized dedupe — makes
+    that retry exactly-once. ``stats['committed']`` counts successes."""
+    for _ in range(n_ops):
+        tr = db.create_transaction()
+        tr.options.set_automatic_idempotency()
+        while True:
+            yield
+            try:
+                cur = _dec(tr.get(key) or _enc(0))
+                tr.set(key, _enc(cur + 1))
+                tr.commit()
+                stats["committed"] += 1
+                break
+            except FDBError as e:
+                if not e.is_retryable:
+                    raise
+                stats["retried_1021"] += 1 if e.code == 1021 else 0
+                tr.on_error(e)
 
 
 def slow_cycle_workload(db, n_nodes, n_ops, rng, prefix=b"cycle/"):

@@ -91,6 +91,20 @@ class TransactionOptions:
     def set_access_system_keys(self):
         pass
 
+    def set_idempotency_id(self, idempotency_id):
+        """Ref: IDEMPOTENCY_ID — a client-chosen token (≤255 bytes) the
+        proxy records atomically with the commit; a retry after 1021
+        resolves to the original outcome instead of double-applying."""
+        if not idempotency_id or len(idempotency_id) > 255:
+            raise err("invalid_option_value")
+        self._tr._idempotency_id = bytes(idempotency_id)
+
+    def set_automatic_idempotency(self):
+        """Ref: AUTOMATIC_IDEMPOTENCY — generate a random id at commit
+        time (kept across the retry loop) so commit_unknown_result
+        becomes exactly-once without the caller inventing tokens."""
+        self._tr._auto_idempotency = True
+
 
 class _Snapshot:
     """Snapshot-isolation view: reads add no read conflict ranges."""
@@ -147,6 +161,8 @@ class Transaction:
         self._next_write_no_conflict = False
         self._report_conflicting_keys = False
         self._lock_aware = False
+        self._idempotency_id = None
+        self._auto_idempotency = False
         self._tags = []  # transaction tags (per-tag throttling)
         self._retry_limit = None
         self._max_retry_delay = knobs.max_retry_delay_s
@@ -492,7 +508,15 @@ class Transaction:
             write_conflict_ranges=_coalesce(self._write_conflicts),
             report_conflicting_keys=self._report_conflicting_keys,
             lock_aware=self._lock_aware,
+            idempotency_id=self._ensure_idempotency_id(),
         )
+
+    def _ensure_idempotency_id(self):
+        if self._idempotency_id is None and self._auto_idempotency:
+            import os as _os
+
+            self._idempotency_id = _os.urandom(16)
+        return self._idempotency_id
 
     def _finish_commit(self, result):
         """Mixed data+management transactions are NOT atomic: the data
@@ -505,14 +529,25 @@ class Transaction:
         the writes the new lock exists to fence, and raising here would
         falsely report a durably-committed transaction as failed."""
         if isinstance(result, FDBError):
-            self._state = "error"
-            # conflict reporting: the failed txn's conflicting read ranges
-            # become readable at \xff\xff/transaction/conflicting_keys/
-            # until the next reset (ref: SpecialKeySpace ConflictingKeys)
-            self._conflicting_ranges = getattr(
-                result, "conflicting_key_ranges", None
-            )
-            raise result
+            if result.code == 1021 and self._idempotency_id is not None:
+                # commit_unknown_result disambiguation (ref:
+                # IdempotencyId.actor.cpp): the id row is written
+                # atomically WITH the mutations, so its presence at a
+                # fresh read version proves the commit applied — resolve
+                # to the original outcome instead of surfacing 1021
+                recovered = self._lookup_idempotency()
+                if recovered is not None:
+                    result = recovered
+            if isinstance(result, FDBError):
+                self._state = "error"
+                # conflict reporting: the failed txn's conflicting read
+                # ranges become readable at
+                # \xff\xff/transaction/conflicting_keys/ until the next
+                # reset (ref: SpecialKeySpace ConflictingKeys)
+                self._conflicting_ranges = getattr(
+                    result, "conflicting_key_ranges", None
+                )
+                raise result
         # the data half is durable regardless of what the management
         # half does below: record it first so the client can always
         # observe what committed (mixed transactions are not atomic)
@@ -535,6 +570,24 @@ class Transaction:
                 raise
         self._state = "committed"
         self._activate_watches()
+
+    def _lookup_idempotency(self):
+        """Best-effort id-row check at a fresh read version: the commit
+        version if the id committed, else None. A cluster mid-recovery
+        can fail the check — the 1021 then stands and the retry loop
+        resubmits the SAME id, where the proxy's dedupe (the
+        authoritative check, serialized with every commit) resolves it."""
+        from foundationdb_tpu.core import systemdata
+
+        try:
+            rv = self._cluster.grv_proxy.get_read_version(
+                priority="immediate"
+            )
+            key = systemdata.idmp_key(self._idempotency_id)
+            row = self._cluster.read_storage(key).get(key, rv)
+        except Exception:
+            return None
+        return None if row is None else systemdata.unpack_version(row)
 
     def _precheck_special_lock(self):
         """A mixed data+management transaction checks the lock BEFORE the
@@ -613,12 +666,18 @@ class Transaction:
         time.sleep(delay)
         self._backoff = self._backoff * self.db._knobs.backoff_growth
         # timeout/retry_limit/max_retry_delay persist across resets, like
-        # the reference binding (fdb_transaction_reset keeps those options)
+        # the reference binding (fdb_transaction_reset keeps those
+        # options); the idempotency id persists too — the SAME id must
+        # ride every retry of this logical transaction or the proxy's
+        # dedupe has nothing to match (ref: IdempotencyId surviving
+        # onError)
         keep = (self._retries, self._backoff, self._retry_limit,
-                self._max_retry_delay, self._timeout_s)
+                self._max_retry_delay, self._timeout_s,
+                self._idempotency_id, self._auto_idempotency)
         self._reset()
         (self._retries, self._backoff, self._retry_limit,
-         self._max_retry_delay, self._timeout_s) = keep
+         self._max_retry_delay, self._timeout_s,
+         self._idempotency_id, self._auto_idempotency) = keep
 
     def reset(self):
         self._reset()
