@@ -9,6 +9,7 @@ storage pipeline as user data.
 """
 
 import json
+import struct
 
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"  # '0' = '/'+1
@@ -31,14 +32,10 @@ def idmp_key(idempotency_id):
 
 
 def pack_version(v):
-    import struct
-
     return struct.pack(">q", v)
 
 
 def unpack_version(b):
-    import struct
-
     return struct.unpack(">q", b)[0]
 
 

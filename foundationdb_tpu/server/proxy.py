@@ -408,9 +408,13 @@ class CommitProxy:
 
     def _commit_batches_locked(self, request_batches):
         # the pipelined backlog must dedupe too — 1021 retries are MOST
-        # likely to arrive on exactly this throughput path. Any matched
-        # id drops the backlog to the per-batch route, whose dedupe
-        # answers the duplicate its original version.
+        # likely to arrive on exactly this throughput path. The scan
+        # costs one in-memory storage get per id-CARRYING request
+        # (id-free traffic pays nothing); a matched id — rare, only a
+        # real 1021 retry — drops the backlog to the per-batch route,
+        # whose dedupe answers the duplicate its original version.
+        # Degrading the whole backlog on a match trades throughput for
+        # simplicity exactly once per retry, not steady-state.
         if any(getattr(r, "idempotency_id", None)
                and self._idmp_lookup(r.idempotency_id) is not None
                for reqs in request_batches for r in reqs):
@@ -623,8 +627,24 @@ class CommitProxy:
                 # replaces it wholesale (re-ingest from live teammates),
                 # so skipping cannot strand a partial state
                 continue
-            self.storages[sid].apply(cv, muts)
-            self.storages[sid].advance_window(window)
+            try:
+                self.storages[sid].apply(cv, muts)
+                self.storages[sid].advance_window(window)
+            except BaseException:
+                # the batch IS committed — the log is durable — so an
+                # apply failure must not fail the commit (a 1021 here
+                # would lie: a retry would pass the idempotency dedupe,
+                # whose lookup reads applied state, and double-commit
+                # into the log). The failed storage's state is suspect
+                # (possibly half-applied): declare it dead so
+                # recruitment replays the log from its durable version,
+                # restoring log↔storage agreement (ref: storage apply
+                # being async from the commit point in the reference).
+                from foundationdb_tpu.utils.trace import TraceEvent
+
+                TraceEvent("StorageApplyFailed", severity=40).detail(
+                    storage=sid, version=cv).log()
+                self.storages[sid].kill()
         if self.change_feeds is not None and batch_mutations:
             # after the log has the batch (durable order) and before the
             # version is readable — consumers reading up to a GRV they
@@ -675,7 +695,7 @@ class CommitProxy:
         for k, v in live.read_range(systemdata.IDMP_PREFIX,
                                     systemdata.IDMP_END, live.version):
             if systemdata.unpack_version(v) < horizon:
-                out.append(Mutation(Op.CLEAR_RANGE, k, k + b"\x00"))
+                out.append(Mutation(Op.CLEAR, k, None))
                 if len(out) >= cap:
                     break
         return out

@@ -23,6 +23,7 @@ from foundationdb_tpu.server.kvstore import open_engine
 from foundationdb_tpu.server.tlog import TLogSystem
 from foundationdb_tpu.sim.buggify import Buggify
 from foundationdb_tpu.sim.network import SimNetwork
+from foundationdb_tpu.utils.trace import TraceEvent
 
 
 class FaultyCommitProxy:
@@ -102,13 +103,20 @@ class Simulation:
     SIM_DT = 0.001
 
     def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
-                 datadir=None, engine="memory", **cluster_kwargs):
+                 datadir=None, engine="memory", machines=0, **cluster_kwargs):
         self.seed = seed
         self.engine_kind = engine  # "memory" | "versioned" | "redwood" | "sqlite"
         self.rng = random.Random(seed)
         self.buggify = Buggify(seed=seed, enabled=buggify)
         self.crash_p = crash_p
         self.n_resolvers = n_resolvers
+        # machines > 0 turns on the MACHINE fault model (ref: sim2's
+        # machine abstraction): roles are placed onto simulated machines
+        # and a reboot kills every co-located role TOGETHER + stalls the
+        # network — the correlated-failure shape role-level kills can't
+        # produce. 0 = role-level faults only (the historical model).
+        self.n_machines = machines
+        self.machine_reboots = 0
         self.cluster_kwargs = dict(cluster_kwargs)
         self.cluster_kwargs.setdefault("resolver_backend", "cpu")
         self.datadir = datadir or tempfile.mkdtemp(prefix="fdbtpu-sim-")
@@ -204,6 +212,8 @@ class Simulation:
             if self.crash_p and self.buggify("cluster_crash", fire_p=self.crash_p):
                 self.crash_and_recover()
             self._maybe_fault_roles()
+            if self.n_machines:
+                self._maybe_reboot_machine()
             if self.net.pending and self.buggify("net_partition", fire_p=0.0015):
                 self.net.partition(self.rng.randint(5, 30))
             self.net.deliver_due(self.steps)
@@ -288,6 +298,90 @@ class Simulation:
                 )
                 c.grv_proxy = FaultyGrvProxy(c.grv_proxy, self.buggify)
                 self._pump = getattr(c.commit_proxy, "pump", None)
+
+    # ───────────────────── machine fault model ────────────────────────
+    # Ref: fdbrpc/sim2.actor.cpp — the simulator models MACHINES hosting
+    # several processes; killMachine takes every co-located role down in
+    # one event and the machine's network stalls. Placement is offset
+    # round-robin so a machine loss pairs DIFFERENT storage/tlog/
+    # resolver indices (the correlated shapes a rack failure produces);
+    # the txn-system roles (sequencer + commit proxy) live on machine 0.
+    def machine_roles(self, mid):
+        """(storages, tlog_replicas, resolvers, has_txn_system) hosted
+        on machine ``mid`` under the current cluster incarnation."""
+        c = self.cluster
+        n = self.n_machines
+        storages = [sid for sid in range(len(c.storages)) if sid % n == mid]
+        tlogs = []
+        if isinstance(c.tlog, TLogSystem):
+            tlogs = [i for i in range(len(c.tlog.logs))
+                     if (i + 1) % n == mid]
+        resolvers = [i for i in range(len(c.resolvers)) if i % n == mid]
+        return storages, tlogs, resolvers, mid == 0
+
+    def _machine_killable(self, mid):
+        """A reboot may not make the cluster unrecoverable: the log must
+        keep its ack quorum OUTSIDE the machine, and every shard owned
+        by a machine-hosted storage needs a live owner elsewhere (ref:
+        sim2's canKillProcesses protection sets)."""
+        c = self.cluster
+        storages, tlogs, _, _ = self.machine_roles(mid)
+        if isinstance(c.tlog, TLogSystem) and tlogs:
+            surviving = sum(
+                1 for i, log in enumerate(c.tlog.logs)
+                if log.alive and i not in tlogs
+            )
+            if surviving < c.tlog.quorum:
+                return False
+        for sid in storages:
+            if not c.storages[sid].alive:
+                continue
+            for team in c.dd.map.teams:
+                if sid in team and not any(
+                    t not in storages and c.storages[t].alive
+                    for t in team
+                ):
+                    return False
+        return True
+
+    def reboot_machine(self, mid):
+        """Kill every role the machine hosts, in one event, and stall
+        the network briefly (its peers see timeouts while it boots).
+        Recovery is the ordinary failure-monitor path: storages reboot
+        onto their durable engines and replay the log, tlog replicas
+        revive, resolvers respawn fenced, and a machine-0 loss forces a
+        full txn-system recovery generation."""
+        c = self.cluster
+        storages, tlogs, resolvers, txn_system = self.machine_roles(mid)
+        for sid in storages:
+            if c.storages[sid].alive:
+                c.storages[sid].kill()
+        for i in tlogs:
+            if c.tlog.logs[i].alive:
+                c.tlog.kill(i)
+        for i in resolvers:
+            if c.resolvers[i].alive:
+                c.resolvers[i].kill()
+        if txn_system:
+            if c.sequencer.alive:
+                c.sequencer.kill()
+            target = c._commit_target()
+            if target.alive:
+                target.kill()
+        if self.net.pending:
+            self.net.partition(self.rng.randint(3, 12))
+        self.machine_reboots += 1
+        TraceEvent("SimMachineReboot").detail(
+            machine=mid, storages=storages, tlogs=tlogs,
+            resolvers=resolvers, txn_system=txn_system).log()
+
+    def _maybe_reboot_machine(self):
+        if not self.buggify("machine_reboot", fire_p=0.0015):
+            return
+        victims = [m for m in range(self.n_machines)
+                   if self._machine_killable(m)]
+        if victims:
+            self.reboot_machine(self.rng.choice(victims))
 
     def _storage_killable(self, sid):
         """Every shard sid owns must keep one other live owner."""

@@ -194,6 +194,51 @@ def test_backlog_path_dedupes_resubmission(cluster):
     assert s.get(b"z", s.version) == b"9"
 
 
+def test_storage_apply_failure_commits_not_1021():
+    """Regression (round-5 review): an apply exception AFTER the tlog
+    push must not become 1021 — the commit IS durable, and a 1021 retry
+    would pass the dedupe (which reads applied state) and double-commit
+    into the log. The failed storage dies instead; recruitment replays
+    the log from its durable version, restoring agreement."""
+    c = Cluster(resolver_backend="cpu", n_storage=2, **TEST_KNOBS)
+    try:
+        db = c.database()
+        db[b"pre"] = b"1"
+        s1 = c.storages[1]
+        orig_apply = s1.apply
+        s1.apply = lambda *a, **k: (_ for _ in ()).throw(
+            MemoryError("apply blew up"))
+        rv = c.grv_proxy.get_read_version()
+        v = c.commit_proxy.commit(CommitRequest(
+            read_version=rv, mutations=[Mutation(Op.SET, b"k", b"v")],
+            read_conflict_ranges=[],
+            write_conflict_ranges=[(b"k", b"k\x00")],
+            idempotency_id=b"apply-tok",
+        ))
+        assert not isinstance(v, FDBError)  # committed, NOT 1021
+        assert not s1.alive  # suspect storage declared dead
+        s1.apply = orig_apply
+        events = c.detect_and_recruit()
+        assert ("storage", 1) in events
+        # the recruit replayed the logged batch: replicas agree
+        s1b = c.storages[1]
+        assert s1b.get(b"k", s1b.version) == b"v"
+        assert c.consistency_check() == []
+        # and the id row is everywhere, so a retry still dedupes
+        retry = CommitRequest(
+            read_version=c.grv_proxy.get_read_version(),
+            mutations=[Mutation(Op.SET, b"k", b"AGAIN")],
+            read_conflict_ranges=[],
+            write_conflict_ranges=[(b"k", b"k\x00")],
+            idempotency_id=b"apply-tok",
+        )
+        assert c.commit_proxy.commit(retry) == v
+        s0 = c.storages[0]
+        assert s0.get(b"k", s0.version) == b"v"
+    finally:
+        c.close()
+
+
 def test_id_rows_gc_past_retention():
     """Rows older than the retention horizon — a deliberate MULTIPLE of
     the MVCC window, since 1021 retries carry fresh read versions and

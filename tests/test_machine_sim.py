@@ -1,0 +1,243 @@
+"""Machine-level simulation faults (ref: fdbrpc/sim2.actor.cpp's
+machine model): roles are placed onto simulated machines; a reboot
+kills every co-located role TOGETHER and stalls the network — the
+correlated-failure shape role-level kills cannot produce. The headline
+scenario (VERDICT r4 #6): a machine reboot mid-workload triggers a
+txn-system recovery while a continuous backup keeps running, and a
+restore afterwards lands on a consistent mid-workload version.
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.sim.simulation import Simulation
+from foundationdb_tpu.sim.workloads import (
+    cycle_check,
+    cycle_setup,
+    cycle_workload,
+)
+
+from conftest import TEST_KNOBS
+
+
+def _machine_sim(seed, tmp_path, **kw):
+    kw.setdefault("machines", 3)
+    kw.setdefault("n_storage", 3)
+    kw.setdefault("replication", 2)
+    kw.setdefault("n_tlogs", 3)
+    kw.setdefault("crash_p", 0.0)  # machine faults, not whole-cluster
+    return Simulation(
+        seed=seed, datadir=str(tmp_path / f"m{seed}"),
+        **{**TEST_KNOBS, **kw},
+    )
+
+
+def test_machine_placement_covers_all_roles(tmp_path):
+    sim = _machine_sim(1, tmp_path)
+    try:
+        seen_s, seen_t, seen_r = set(), set(), set()
+        txn_machines = []
+        for m in range(3):
+            storages, tlogs, resolvers, txn = sim.machine_roles(m)
+            seen_s.update(storages)
+            seen_t.update(tlogs)
+            seen_r.update(resolvers)
+            if txn:
+                txn_machines.append(m)
+        assert seen_s == {0, 1, 2}
+        assert seen_t == {0, 1, 2}
+        assert seen_r == {0}
+        assert txn_machines == [0]  # sequencer+proxy live on machine 0
+        # offset placement: a machine never hosts its same-index tlog
+        for m in range(3):
+            storages, tlogs, _, _ = sim.machine_roles(m)
+            assert not (set(storages) & set(tlogs))
+    finally:
+        sim.close()
+
+
+def test_machine_reboot_kills_colocated_roles_together(tmp_path):
+    sim = _machine_sim(2, tmp_path)
+    try:
+        c = sim.cluster
+        db = sim.db
+        for i in range(10):
+            db[b"k%d" % i] = b"v%d" % i
+        storages, tlogs, _, _ = sim.machine_roles(1)
+        assert sim._machine_killable(1)
+        sim.reboot_machine(1)
+        # ONE event took them all down
+        assert all(not c.storages[s].alive for s in storages)
+        assert all(not c.tlog.logs[t].alive for t in tlogs)
+        # the cluster keeps committing on the degraded tiers (quorum
+        # survives outside the machine)
+        db[b"during"] = b"x"
+        assert db[b"during"] == b"x"
+        events = c.detect_and_recruit()
+        roles = {r for r, _ in events}
+        assert "storage" in roles and "tlog" in roles
+        for i in range(10):
+            assert db[b"k%d" % i] == b"v%d" % i
+        assert c.consistency_check() == []
+    finally:
+        sim.close()
+
+
+def test_machine0_reboot_forces_txn_recovery(tmp_path):
+    sim = _machine_sim(3, tmp_path)
+    try:
+        c = sim.cluster
+        db = sim.db
+        db[b"pre"] = b"1"
+        gen0 = c.generation
+        sim.reboot_machine(0)  # hosts sequencer + commit proxy
+        tr = db.create_transaction()
+        tr[b"during"] = b"x"
+        with pytest.raises(FDBError) as ei:
+            tr.commit()
+        assert ei.value.code in (1021, 1037)
+        events = c.detect_and_recruit()
+        assert ("txn-system", 0) in events
+        assert c.generation > gen0
+        assert db[b"pre"] == b"1"
+        db[b"post"] = b"2"
+        assert db[b"post"] == b"2"
+    finally:
+        sim.close()
+
+
+def test_unkillable_machine_protected(tmp_path):
+    """The protection set: a machine whose loss would drop the log
+    below quorum (a peer's replicas already dead) must not reboot."""
+    sim = _machine_sim(4, tmp_path)
+    try:
+        c = sim.cluster
+        # kill machine 1's tlog replica out-of-band: quorum 2 of 3 now
+        # rides on the OTHER two replicas
+        _, tlogs1, _, _ = sim.machine_roles(1)
+        for t in tlogs1:
+            c.tlog.kill(t)
+        # the machines hosting the two surviving replicas are now
+        # quorum-critical: neither may reboot
+        protected = {m for m in range(3)
+                     if sim.machine_roles(m)[1]  # hosts a tlog replica
+                     and any(c.tlog.logs[t].alive
+                             for t in sim.machine_roles(m)[1])}
+        for m in protected:
+            assert not sim._machine_killable(m), m
+        # hot random injection must still never break the quorum
+        sim.buggify._sites["machine_reboot"] = True
+        orig = sim.buggify
+
+        def hot(name, fire_p=None):
+            return orig(name, fire_p=1.0 if name == "machine_reboot"
+                        else fire_p)
+
+        sim.buggify = hot
+        for _ in range(50):
+            sim._maybe_reboot_machine()
+            assert sum(1 for log in c.tlog.logs if log.alive) \
+                >= c.tlog.quorum
+    finally:
+        sim.close()
+
+
+def test_machine_reboot_with_backup_restores_consistent_version(tmp_path):
+    """The VERDICT r4 #6 done-condition: machine reboots (including the
+    txn-system machine) fire MID-WORKLOAD while a continuous backup
+    agent keeps ticking; the run must (a) exercise a txn-system
+    recovery caused by a machine loss, and (b) afterwards restore a
+    MID-workload version whose cycle invariant holds — the backup
+    stayed consistent through correlated failures."""
+    from foundationdb_tpu.server.cluster import Cluster
+    from foundationdb_tpu.tools.backup import ContinuousBackupAgent, restore
+
+    n_nodes = 12
+    sim = _machine_sim(7, tmp_path)
+    try:
+        gen0 = sim.cluster.generation
+        cycle_setup(sim.db, n_nodes)
+        agent = ContinuousBackupAgent(sim.db, str(tmp_path / "bk"))
+        agent.start()
+        marks = []  # restore-frontier versions after each tick
+
+        # certainty over luck for a short run: force the site active and
+        # hot so machine reboots definitely fire mid-workload
+        sim.buggify._sites["machine_reboot"] = True
+        orig = sim.buggify
+
+        def hot(name, fire_p=None):
+            if name == "machine_reboot":
+                return orig(name, fire_p=0.02)
+            return orig(name, fire_p=fire_p)
+
+        sim.buggify = hot
+
+        def backup_actor():
+            def healthy():
+                c = sim.cluster
+                return c.sequencer.alive and c._commit_target().alive
+
+            for _ in range(30):
+                for _ in range(6):
+                    yield
+                # a tick against a dead txn system would spin its
+                # blocking retry loop INSIDE one cooperative step and
+                # the sim could never pump the failure monitor — skip
+                # the lap instead, like a real agent backing off
+                if not healthy():
+                    continue
+                try:
+                    agent.tick()
+                    marks.append(agent.log_through)
+                except FDBError as e:  # dead-role window: retry next lap
+                    if not e.is_retryable:
+                        raise
+
+        def chaos_actor():
+            # the certain event: mid-workload, take down the machine
+            # hosting the WHOLE txn system (random reboots ride along
+            # for the other machines)
+            for _ in range(40):
+                yield
+            sim.reboot_machine(0)
+            yield
+
+        for a in range(3):
+            rng = random.Random(700 + a)
+            sim.add_workload(
+                f"cycle{a}", cycle_workload(sim.db, n_nodes, 25, rng)
+            )
+        sim.add_workload("backup", backup_actor())
+        sim.add_workload("chaos", chaos_actor())
+        sim.run()
+        sim.quiesce()
+
+        assert sim.machine_reboots > 0, "no machine reboot ever fired"
+        # machine 0 hosts the txn system: its reboot forces a recovery
+        # generation (detected by the monitor inside the run loop)
+        assert sim.cluster.generation > gen0, \
+            "no txn-system recovery was exercised"
+        cycle_check(sim.db, n_nodes)  # the live cluster's invariant
+        try:
+            agent.tick()
+            marks.append(agent.log_through)
+        except FDBError:
+            pass
+        agent.stop()
+
+        assert len(marks) >= 3, f"backup barely ticked: {marks}"
+        # restore a MID-workload mark (not the final quiesced state) and
+        # the head; the cycle invariant must hold at each
+        for target_v in (marks[len(marks) // 2], marks[-1]):
+            r = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+            try:
+                rdb = r.database()
+                restore(rdb, str(tmp_path / "bk"), target_version=target_v)
+                cycle_check(rdb, n_nodes)
+            finally:
+                r.close()
+    finally:
+        sim.close()
