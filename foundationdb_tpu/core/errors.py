@@ -38,6 +38,11 @@ _ERRORS = {
     2132: "tenant_already_exists",
     2133: "tenant_not_empty",
     2134: "tenants_disabled",
+    2144: "tenant_locked",  # mid-move fence (ref: metacluster moves)
+    2160: "invalid_metacluster_operation",
+    2161: "cluster_already_registered",
+    2165: "cluster_not_empty",
+    2166: "metacluster_no_capacity",
     2200: "api_version_unset",
 }
 
@@ -45,7 +50,7 @@ _BY_NAME = {v: k for k, v in _ERRORS.items()}
 
 # Errors on which the standard retry loop (Transaction.on_error) retries.
 # Ref: fdb_error_predicate(FDB_ERROR_PREDICATE_RETRYABLE, ...) in bindings/c.
-RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1213})
+RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1213, 2144})
 MAYBE_COMMITTED = frozenset({1021})
 
 

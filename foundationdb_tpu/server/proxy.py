@@ -630,7 +630,7 @@ class CommitProxy:
             try:
                 self.storages[sid].apply(cv, muts)
                 self.storages[sid].advance_window(window)
-            except BaseException:
+            except Exception:  # NOT BaseException: interrupts must escape
                 # the batch IS committed — the log is durable — so an
                 # apply failure must not fail the commit (a 1021 here
                 # would lie: a retry would pass the idempotency dedupe,

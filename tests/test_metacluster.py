@@ -1,0 +1,227 @@
+"""Metacluster-lite (ref: upstream metacluster/ — management cluster,
+data-cluster registry, tenant assignment, tenant MOVE between data
+clusters)."""
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.layers.metacluster import Metacluster
+from foundationdb_tpu.layers.tenant import TenantManagement, tenant_tag
+from foundationdb_tpu.server.cluster import Cluster
+
+from conftest import TEST_KNOBS
+
+
+@pytest.fixture
+def meta(tmp_path):
+    clusters = [Cluster(resolver_backend="cpu", **TEST_KNOBS)
+                for _ in range(3)]
+    mgmt, d1, d2 = (c.database() for c in clusters)
+    mc = Metacluster.create(mgmt)
+    mc.register_data_cluster(b"dc1", d1, capacity=2)
+    mc.register_data_cluster(b"dc2", d2, capacity=2)
+    yield mc, d1, d2
+    for c in clusters:
+        c.close()
+
+
+def test_registration_guards(tmp_path, meta):
+    mc, d1, _ = meta
+    # a data cluster cannot be registered twice (it carries a mark)
+    with pytest.raises(FDBError) as ei:
+        mc.register_data_cluster(b"dc1-again", d1)
+    assert ei.value.code == 2161
+    # the management cluster cannot be its own data cluster
+    with pytest.raises(FDBError):
+        mc.register_data_cluster(b"self", mc.db)
+    # a cluster with pre-existing tenants is refused
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        db = c.database()
+        TenantManagement.create_tenant(db, b"squatter")
+        with pytest.raises(FDBError) as ei2:
+            mc.register_data_cluster(b"dirty", db)
+        assert ei2.value.code == 2165
+    finally:
+        c.close()
+
+
+def test_tenant_assignment_balances_by_load(meta):
+    mc, d1, d2 = meta
+    placed = [mc.create_tenant(b"t%d" % i) for i in range(4)]
+    assert sorted(placed) == [b"dc1", b"dc1", b"dc2", b"dc2"]
+    # capacity 2+2 exhausted: the fifth tenant is refused
+    with pytest.raises(FDBError) as ei:
+        mc.create_tenant(b"t4")
+    assert ei.value.code == 2166
+    # the tenant exists ON its data cluster, not just in the registry
+    names = [n for n, _ in TenantManagement.list_tenants(d1)]
+    assert sorted(names)[0] in (b"t0", b"t1")
+    mc.delete_tenant(b"t0")
+    assert mc.create_tenant(b"t4") == b"dc1"  # freed slot reused
+
+
+def test_open_tenant_routes_to_owner(meta):
+    mc, d1, d2 = meta
+    mc.create_tenant(b"alpha")  # lands on dc1 (least loaded, tie → first)
+    t = mc.open_tenant(b"alpha")
+    t[b"k"] = b"v"
+    assert t[b"k"] == b"v"
+    # the raw rows live on dc1 only
+    rows1 = d1.get_range(b"\xfd", b"\xfe")
+    rows2 = d2.get_range(b"\xfd", b"\xfe")
+    assert len(rows1) == 1 and rows2 == []
+
+
+def test_move_tenant_between_clusters(meta):
+    """The VERDICT done-condition: a tenant moves between two clusters —
+    data identical, exactly one live copy, quota + group carried, old
+    handles fenced, new handles routed to the destination."""
+    mc, d1, d2 = meta
+    mc.create_tenant(b"mv", group=b"gold")
+    TenantManagement.set_tenant_quota(d1, b"mv", 500.0)
+    t = mc.open_tenant(b"mv")
+    for i in range(20):
+        t[b"row%02d" % i] = b"val%d" % i
+    old_handle = t
+
+    mc.move_tenant(b"mv", b"dc2")
+
+    assert mc.list_tenants()[b"mv"]["cluster"] == "dc2"
+    t2 = mc.open_tenant(b"mv")
+    for i in range(20):
+        assert t2[b"row%02d" % i] == b"val%d" % i
+    t2[b"post"] = b"moved"
+    assert t2[b"post"] == b"moved"
+    # exactly one live copy: the source's raw space is empty
+    assert d1.get_range(b"\xfd", b"\xfe") == []
+    # quota + group travelled (live ratekeeper limit on dst, row on dst)
+    assert TenantManagement.get_tenant_quota(d2, b"mv") == 500.0
+    assert TenantManagement.get_tenant_group(d2, b"mv") == b"gold"
+    assert tenant_tag(b"mv") in d2._cluster.ratekeeper.tag_quotas
+    assert TenantManagement.get_tenant_quota(d1, b"mv") is None
+    assert tenant_tag(b"mv") not in d1._cluster.ratekeeper.tag_quotas
+    # a handle that outlived the move is fenced, not silently stale
+    with pytest.raises(FDBError) as ei:
+        old_handle[b"row00"]
+    assert ei.value.code == 2108  # tenant_not_found on the source
+    # registry load counts moved with the tenant
+    dcs = mc.list_data_clusters()
+    assert dcs[b"dc1"]["tenants"] == 0 and dcs[b"dc2"]["tenants"] == 1
+
+
+def test_open_during_move_is_locked_retryable(meta):
+    mc, d1, d2 = meta
+    mc.create_tenant(b"busy")
+    src_prefix = d1.run(
+        lambda tr: tr.get(b"\xff/tenant/map/busy"))
+    mc._set_assignment(b"busy", b"dc1", "moving", src_prefix=src_prefix,
+                       dst=b"dc2")
+    with pytest.raises(FDBError) as ei:
+        mc.open_tenant(b"busy")
+    assert ei.value.code == 2144 and ei.value.is_retryable
+    # finish the move; open succeeds on the destination
+    mc.resume_move(b"busy", b"dc2")
+    t = mc.open_tenant(b"busy")
+    t[b"k"] = b"v"
+    assert mc.list_tenants()[b"busy"]["cluster"] == "dc2"
+
+
+@pytest.mark.parametrize("crash_after", ["moving", "copied"])
+def test_move_resumes_after_crash(meta, crash_after, monkeypatch):
+    """Kill the move after each persisted state mark; resume_move must
+    land the tenant intact on the destination (the source's rows
+    survive until the 'copied' mark is durable, so no step can lose
+    data)."""
+    mc, d1, d2 = meta
+    mc.create_tenant(b"frag")
+    t = mc.open_tenant(b"frag")
+    for i in range(8):
+        t[b"r%d" % i] = b"v%d" % i
+
+    class Boom(Exception):
+        pass
+
+    if crash_after == "moving":
+        # crash right after the state flips to moving: nothing fenced,
+        # nothing copied yet
+        orig = mc._drive_move
+        monkeypatch.setattr(
+            mc, "_drive_move",
+            lambda *a: (_ for _ in ()).throw(Boom()))
+        with pytest.raises(Boom):
+            mc.move_tenant(b"frag", b"dc2")
+        monkeypatch.setattr(mc, "_drive_move", orig)
+    else:
+        # crash between the 'copied' mark and the source scrub
+        orig_set = mc._set_assignment
+
+        def set_then_boom(name, cluster, state, **kw):
+            orig_set(name, cluster, state, **kw)
+            if state == "copied":
+                raise Boom()
+
+        monkeypatch.setattr(mc, "_set_assignment", set_then_boom)
+        with pytest.raises(Boom):
+            mc.move_tenant(b"frag", b"dc2")
+        monkeypatch.setattr(mc, "_set_assignment", orig_set)
+
+    assert mc.list_tenants()[b"frag"]["state"] in ("moving", "copied")
+    # a resume may not re-target: the recorded destination is the law
+    with pytest.raises(FDBError):
+        mc.resume_move(b"frag", b"dc1")
+    # resume from a FRESH process: a new handle re-attaches the
+    # already-registered data clusters (no re-registration) and drives
+    # the recorded move to completion with no dst argument at all
+    mc2 = Metacluster(mc.db)
+    mc2.attach_data_cluster(b"dc1", d1)
+    mc2.attach_data_cluster(b"dc2", d2)
+    mc2.resume_move(b"frag")
+    t2 = mc2.open_tenant(b"frag")
+    for i in range(8):
+        assert t2[b"r%d" % i] == b"v%d" % i
+    assert d1.get_range(b"\xfd", b"\xfe") == []  # one live copy
+    assert mc2.list_tenants()[b"frag"]["cluster"] == "dc2"
+
+
+def test_register_failure_rolls_back_cleanly(meta):
+    """A data cluster that refuses its mark (already in a metacluster)
+    must not leave a registry row behind; and the refused cluster is
+    NOT bricked — it keeps working where it already belongs."""
+    mc, d1, _ = meta
+    with pytest.raises(FDBError) as ei:
+        mc.register_data_cluster(b"dc1-alias", d1)  # d1 already marked
+    assert ei.value.code == 2161
+    assert b"dc1-alias" not in mc.list_data_clusters()
+    assert mc.create_tenant(b"still-works") in (b"dc1", b"dc2")
+
+
+def test_create_tenant_resumes_registering_state(meta, monkeypatch):
+    """Crash between the management assignment and the data-side
+    create: the assignment stays 'registering' (open_tenant refuses it
+    retryably, never a 2108 handle), and re-calling create_tenant
+    finishes the job on the RECORDED cluster."""
+    mc, d1, d2 = meta
+
+    class Boom(Exception):
+        pass
+
+    orig = TenantManagement.create_tenant
+    monkeypatch.setattr(
+        TenantManagement, "create_tenant",
+        staticmethod(lambda *a, **k: (_ for _ in ()).throw(Boom())))
+    with pytest.raises(Boom):
+        mc.create_tenant(b"half")
+    monkeypatch.setattr(TenantManagement, "create_tenant",
+                        staticmethod(orig))
+    assert mc.list_tenants()[b"half"]["state"] == "registering"
+    with pytest.raises(FDBError) as ei:
+        mc.open_tenant(b"half")
+    assert ei.value.code == 2144 and ei.value.is_retryable
+    cluster = mc.create_tenant(b"half")  # resume, same slot
+    assert mc.list_tenants()[b"half"]["state"] == "ready"
+    t = mc.open_tenant(b"half")
+    t[b"k"] = b"v"
+    assert t[b"k"] == b"v"
+    # capacity was consumed exactly once
+    assert mc.list_data_clusters()[cluster]["tenants"] == 1
