@@ -648,6 +648,165 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     }
 
 
+def run_e2e_client(cluster_file, seconds, seed, nkeys=100_000,
+                   threads=None, window=32):
+    """ONE client process of the multi-process e2e: YCSB-A-shaped
+    transactions over the RPC transport with client-side commit
+    batching (RemoteCluster(commit_pipeline="thread") — whole windows
+    ride single commit_batch RPCs). Prints one JSON line with its
+    committed/aborted counts; the parent sums across processes."""
+    import threading as _threading
+
+    threads = threads or int(os.environ.get("BENCH_E2E_MP_THREADS", 8))
+
+    import foundationdb_tpu as fdb
+    from foundationdb_tpu.core.errors import FDBError
+
+    db = fdb.open(cluster_file=cluster_file, commit_pipeline="thread",
+                  commit_batch_max=64,
+                  read_workers=os.environ.get(
+                      "BENCH_E2E_READ_WORKERS") == "1")
+    stop = _threading.Event()
+    committed = [0] * threads
+    aborted = [0] * threads
+
+    rmw_frac = float(os.environ.get("BENCH_E2E_MP_RMW", 0.5))
+
+    def client(cid):
+        rng = np.random.default_rng(seed * 100 + cid)
+        ids = rng.integers(0, nkeys, 8192)
+        is_rmw = rng.random(8192) < rmw_frac
+        j = 0
+        while not stop.is_set():
+            trs, futs = [], []
+            for _ in range(window):
+                tr = db.create_transaction()
+                k = b"user%08d" % ids[j % 8192]
+                if is_rmw[j % 8192]:
+                    try:
+                        tr.get(k)
+                    except FDBError:
+                        continue
+                tr.set(k, b"x" * 100)
+                j += 1
+                trs.append(tr)
+                futs.append(tr.commit_async())
+            for tr, fut in zip(trs, futs):
+                fut.result(timeout=60)
+                try:
+                    tr.commit_finish(fut)
+                    committed[cid] += 1
+                except FDBError as e:
+                    if e.code in (1020, 1021):
+                        aborted[cid] += 1
+                    else:
+                        raise
+
+    ts = [_threading.Thread(target=client, args=(i,), daemon=True)
+          for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in ts:
+        t.join(timeout=60)
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({"committed": sum(committed),
+                      "aborted": sum(aborted),
+                      "elapsed": round(elapsed, 3)}), flush=True)
+
+
+def run_e2e_multiproc(seconds=None, n_clients=None):
+    """The OUT-OF-PROCESS e2e (VERDICT r4 do#3: escape the GIL): a real
+    fdbserver process (thread pipeline, native conflict set) driven by
+    N separate client PROCESSES over loopback TCP, each batching its
+    commit windows into single commit_batch RPCs. Client-side
+    transaction machinery burns the clients' own interpreters; the
+    server's GIL runs only the decode + commit pipeline — the
+    architecture the reference deploys (every role its own process)."""
+    import subprocess
+    import tempfile
+
+    env2 = os.environ.copy()
+    env2["JAX_PLATFORMS"] = "cpu"
+    env2["PALLAS_AXON_POOL_IPS"] = ""  # never touch the TPU from here
+    seconds = seconds or float(os.environ.get("BENCH_E2E_MP_SECONDS", 8))
+    n_clients = n_clients or int(os.environ.get("BENCH_E2E_MP_CLIENTS", 4))
+    d = tempfile.mkdtemp(prefix="bench-mp-")
+    cf = os.path.join(d, "fdb.cluster")
+    n_workers = int(os.environ.get("BENCH_E2E_MP_WORKERS", 2))
+    server = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.fdbserver",
+         "--listen", "127.0.0.1:0", "--cluster-file", cf,
+         "--resolver-backend", "native"],
+        stdout=subprocess.PIPE, text=True, env=env2,
+    )
+    workers = []
+    try:
+        line = server.stdout.readline()
+        if "FDBD listening" not in line:
+            raise RuntimeError(f"fdbserver failed to start: {line!r}")
+        lead_addr = line.split("listening on ")[1].split()[0]
+        # storage-worker processes take the READ load off the lead's
+        # interpreter (a commit batch monopolizes its GIL for
+        # milliseconds — reads convoy behind it otherwise); clients
+        # round-robin reads across the workers (read_workers=True)
+        for _ in range(n_workers):
+            w = subprocess.Popen(
+                [sys.executable, "-m",
+                 "foundationdb_tpu.tools.fdbserver",
+                 "--listen", "127.0.0.1:0", "--join", lead_addr],
+                stdout=subprocess.PIPE, text=True, env=env2,
+            )
+            if "FDBD listening" not in w.stdout.readline():
+                raise RuntimeError("storage worker failed to start")
+            workers.append(w)
+        clients = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**env2, "BENCH_MODE": "e2e_client",
+                     "BENCH_E2E_CF": cf,
+                     "BENCH_E2E_SECONDS": str(seconds),
+                     "BENCH_E2E_READ_WORKERS":
+                         "1" if n_workers else "0",
+                     "BENCH_CLIENT_SEED": str(i)},
+                stdout=subprocess.PIPE, text=True,
+            )
+            for i in range(n_clients)
+        ]
+        committed = aborted = 0
+        elapsed = seconds
+        for p in clients:
+            out, _ = p.communicate(timeout=seconds + 120)
+            stats = json.loads(out.strip().splitlines()[-1])
+            committed += stats["committed"]
+            aborted += stats["aborted"]
+            elapsed = max(elapsed, stats["elapsed"])
+        return {
+            "e2e_committed_txns_per_sec": round(committed / elapsed, 1),
+            "e2e_client_processes": n_clients,
+            "e2e_read_workers": n_workers,
+            "e2e_backend": "native",
+            "platform": "cpu",
+            "e2e_mode": "ycsb-multiproc",
+            "e2e_proxies": 1,
+            "e2e_committed_txns": committed,
+            "e2e_aborted_txns": aborted,
+            "e2e_conflict_rate": round(
+                aborted / max(committed + aborted, 1), 4),
+        }
+    finally:
+        for w in workers:
+            w.terminate()
+        server.terminate()
+        for p in workers + [server]:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+
+
 def run_kernel_bench(point, cpu, fallback_note):
     """One kernel-throughput config (point YCSB-A or range-heavy):
     scanned multi-batch dispatches under a bounded pipeline. Returns the
@@ -1127,6 +1286,26 @@ def main():
         extra_s=1300 if fallback_note is not None and mode == "all" else 0
     )
 
+    if mode == "e2e_client":
+        # child of run_e2e_multiproc: drive the workload, print counts
+        run_e2e_client(
+            os.environ["BENCH_E2E_CF"],
+            float(env("BENCH_E2E_SECONDS", 8)),
+            int(env("BENCH_CLIENT_SEED", 0)),
+        )
+        watchdog_finish()
+        return
+
+    if mode == "multiproc":
+        out = run_e2e_multiproc()
+        watchdog_finish()
+        value = out.pop("e2e_committed_txns_per_sec")
+        _emit({"metric": "e2e_committed_txns_per_sec_multiproc",
+               "value": value, "unit": "txns/sec",
+               "vs_baseline": round(value / BASELINE_TXNS_PER_SEC, 3),
+               **out})
+        return
+
     if mode == "sharded_e2e":
         # child of _run_sharded_multilane: exactly one sharded e2e line
         secondary_s = float(env("BENCH_E2E_SECONDS_SECONDARY", 6))
@@ -1281,6 +1460,27 @@ def main():
         _fold("fleet", _e2e_line(cpu, "e2e_committed_txns_per_sec_fleet",
                                  n_proxies=2, seconds=secondary_s),
               E2E_KEYS)
+        # out-of-process e2e: fdbserver + N client processes over
+        # loopback, windows batched into commit_batch RPCs — the
+        # GIL-escape deployment (VERDICT r4 do#3)
+        try:
+            mp = run_e2e_multiproc(seconds=secondary_s + 2)
+            value = mp.pop("e2e_committed_txns_per_sec")
+            mp_line = {"metric": "e2e_committed_txns_per_sec_multiproc",
+                       "value": value, "unit": "txns/sec",
+                       "vs_baseline": round(
+                           value / BASELINE_TXNS_PER_SEC, 3), **mp}
+            _emit(mp_line)
+            _fold("multiproc", mp_line,
+                  E2E_KEYS + ("e2e_client_processes",))
+        except Exception as e:
+            sys.stderr.write(
+                f"multiproc e2e failed: {type(e).__name__}: {e}\n")
+            line = {"metric": "e2e_committed_txns_per_sec_multiproc",
+                    "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+            _emit(line)
+            _fold("multiproc", line, ())
         # the headline e2e (attached to the final line, as in round 2)
         try:
             e2e = run_e2e(cpu)

@@ -36,8 +36,11 @@ class Mutation:
 
     def __init__(self, op, key, param=None):
         self.op = op
-        self.key = bytes(key)
-        self.param = param if param is None else bytes(param)
+        # exact-type fast path: the hot constructors (txn.set, proxy id
+        # rows) always pass bytes; bytes(bytes) still pays a call
+        self.key = key if type(key) is bytes else bytes(key)
+        self.param = (param if param is None or type(param) is bytes
+                      else bytes(param))
 
     def __repr__(self):
         return f"Mutation({self.op.value}, {self.key!r}, {self.param!r})"

@@ -213,6 +213,12 @@ class Metacluster:
     def delete_tenant(self, tenant_name):
         tenant_name = bytes(tenant_name)
         assignment = self._assignment(tenant_name)
+        if assignment["state"] in ("moving", "copied"):
+            # a mid-move tenant has TWO partial copies: deleting the
+            # registry row now would leak the source rows and leave the
+            # destination copy to be silently resurrected by a later
+            # same-name create (round-5 review). Finish the move first.
+            raise err("tenant_locked")
         cluster = assignment["cluster"].encode("latin-1")
         try:
             TenantManagement.delete_tenant(
@@ -272,8 +278,13 @@ class Metacluster:
             return
         if assignment["state"] != "ready":
             raise err("invalid_metacluster_operation")
-        if dst_cluster not in self.list_data_clusters():
+        dcs = self.list_data_clusters()
+        if dst_cluster not in dcs:
             raise err("invalid_metacluster_operation")
+        if dcs[dst_cluster]["tenants"] >= dcs[dst_cluster]["capacity"]:
+            # same invariant create_tenant enforces (ref: the upstream
+            # move refusing a destination without capacity)
+            raise err("metacluster_no_capacity")
         src = self._data_db(src_cluster)
         src_prefix = src.run(
             lambda tr: tr.get(TENANT_MAP_PREFIX + tenant_name))

@@ -95,6 +95,11 @@ class Resolver:
         self.backend = knobs.resolver_backend
         self.base_version = base_version
         self.alive = True
+        # only the device kernel has dedicated point LANES; the host
+        # backends treat a point as the tiny range it is, so the proxy
+        # skips the per-range point/range split for them (it was the
+        # hottest line of the host commit pipeline)
+        self.wants_point_split = self.backend == "tpu"
         if self.backend == "tpu":
             pallas = getattr(knobs, "pallas_ring", "auto")
             use_pallas = pallas == "on" or (

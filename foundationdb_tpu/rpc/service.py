@@ -106,6 +106,7 @@ class ClusterService:
             "resolve_selector": self.resolve_selector,
             "get_range": self.get_range,
             "commit": self.commit,
+            "commit_batch": self.commit_batch,
             "watch_register": self.watch_register,
             "watch_poll": self.watch_poll,
             "watch_wait": self.watch_wait,
@@ -171,6 +172,18 @@ class ClusterService:
             with self._commit_lock:
                 return self.cluster.commit_proxy.commit(request)
         return self.cluster.commit_proxy.commit(request)
+
+    def commit_batch(self, requests):
+        """A client-batched window of commits in ONE RPC (the remote
+        BatchingCommitProxy's flush): decoded once, pipelined once —
+        per-commit RPCs round-trip-bound multi-process deployments
+        (ref: clients streaming batched commits at the proxy)."""
+        target = getattr(self.cluster.commit_proxy, "inner",
+                         self.cluster.commit_proxy)
+        if self._commit_lock is not None:
+            with self._commit_lock:
+                return target.commit_batch(requests)
+        return target.commit_batch(requests)
 
     def watch_register(self, key, seen_value):
         w = self.cluster.read_storage(key).watch(key, seen_value)
@@ -331,11 +344,90 @@ class _RemoteGrvProxy:
         return self._rc._call("get_read_version", priority, tuple(tags))
 
 
+class _CoalescingGrvProxy:
+    """Client-side read-version batching (ref: NativeAPI's
+    readVersionBatcher): concurrent default-priority transactions share
+    GRV RPCs instead of paying one wire round trip each. A request
+    rides the NEXT rpc to START after it arrives — a version granted by
+    an rpc already in flight could miss a commit that completed after
+    that rpc began, which would break external consistency."""
+
+    __slots__ = ("_rc", "_cond", "_started", "_done", "_last", "_leader",
+                 "_max_wanted")
+
+    def __init__(self, rc):
+        self._rc = rc
+        self._cond = threading.Condition()
+        self._started = 0  # GRV rounds begun
+        self._done = 0  # GRV rounds completed
+        self._last = None  # value of the newest completed round
+        self._max_wanted = 0
+        self._leader = False
+
+    def get_read_version(self, priority="default", tags=()):
+        if tags or priority != "default":
+            # tagged/priority requests carry their own admission
+            # semantics: never coalesced into an untagged round
+            return self._rc._call("get_read_version", priority,
+                                  tuple(tags))
+        cond = self._cond
+        with cond:
+            if self._leader:
+                want = self._started + 1  # the NEXT round covers me
+                if want > self._max_wanted:
+                    self._max_wanted = want
+                cond.wait_for(lambda: self._done >= want)
+                v = self._last
+                if v is not None:
+                    return v
+                # my round's rpc failed: fall through to a direct call
+            else:
+                self._leader = True
+                want = None
+        if want is not None:
+            return self._rc._call("get_read_version", "default", ())
+        # leader: run rounds until no one is waiting for a newer one
+        while True:
+            with cond:
+                self._started += 1
+            try:
+                v = self._rc._call("get_read_version", "default", ())
+            except BaseException:
+                with cond:
+                    # release EVERY registered waiter, not just the next
+                    # round's: no leader survives to run later rounds,
+                    # so a waiter parked on want > done+1 would hang
+                    # forever (round-5 review). They see _last None and
+                    # fall back to direct calls.
+                    self._done = max(self._done + 1, self._max_wanted)
+                    self._started = self._done
+                    self._last = None
+                    self._leader = False
+                    cond.notify_all()
+                raise
+            with cond:
+                self._done += 1
+                self._last = v
+                cond.notify_all()
+                # exit decision under the SAME lock registrations take:
+                # either a waiter already wants a newer round (loop) or
+                # later arrivals will see _leader False and lead
+                if self._max_wanted <= self._done:
+                    self._leader = False
+                    return v
+
+
 class _RemoteCommitProxy:
     __slots__ = ("_rc",)
 
     def __init__(self, rc):
         self._rc = rc
+
+    @property
+    def knobs(self):
+        # the client-side BatchingCommitProxy wrapper sizes its batches
+        # from the SERVER's knobs
+        return self._rc.knobs
 
     def commit(self, request):
         try:
@@ -343,6 +435,13 @@ class _RemoteCommitProxy:
         except ConnectionLost:
             # the request may have reached the server: 1021, not a retry
             return FDBError.from_name("commit_unknown_result")
+
+    def commit_batch(self, requests):
+        try:
+            return self._rc._call_once("commit_batch", list(requests))
+        except ConnectionLost:
+            return [FDBError.from_name("commit_unknown_result")
+                    for _ in requests]
 
 
 class _RemoteStorage:
@@ -406,7 +505,8 @@ class RemoteCluster:
     server.cluster.Cluster, every role call an RPC."""
 
     def __init__(self, addresses, connect_timeout=5.0, read_workers=False,
-                 secret=None):
+                 secret=None, commit_pipeline="sync",
+                 commit_batch_max=None):
         if isinstance(addresses, str):
             addresses = [addresses]
         self.addresses = list(addresses)
@@ -424,6 +524,23 @@ class RemoteCluster:
         self.change_feeds = _RemoteChangeFeeds(self)
         self._storage = _RemoteStorage(self)
         self._connect()
+        self.commit_pipeline = commit_pipeline
+        if commit_pipeline == "thread":
+            # concurrent client threads share GRV rounds too (ref:
+            # NativeAPI batching read-version requests)
+            self.grv_proxy = _CoalescingGrvProxy(self)
+            # CLIENT-side commit batching (ref: NativeAPI batching
+            # commits toward the proxies): concurrent transactions in
+            # this process share commit_batch RPCs — one wire round
+            # trip per WINDOW instead of per commit, which is what
+            # makes a multi-process deployment throughput-bound on the
+            # server pipeline rather than on per-commit RTTs. Also
+            # enables commit_async (submit) against remote clusters.
+            from foundationdb_tpu.server.batcher import BatchingCommitProxy
+
+            self.commit_proxy = BatchingCommitProxy(
+                self.commit_proxy, max_batch=commit_batch_max,
+            )
         if read_workers:
             self.refresh_workers()
 
@@ -639,6 +756,8 @@ class RemoteCluster:
         return Database(self)
 
     def close(self):
+        if hasattr(self.commit_proxy, "close"):
+            self.commit_proxy.close()  # client-side batcher thread
         with self._lock:
             self._closed = True
             if self._client is not None:

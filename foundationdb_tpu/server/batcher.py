@@ -32,26 +32,43 @@ import time
 from foundationdb_tpu.core.errors import FDBError
 
 
+_UNSET = object()
+
+
 class CommitFuture:
-    """Resolves to a commit version (int) or an FDBError."""
+    """Resolves to a commit version (int) or an FDBError.
 
-    __slots__ = ("_event", "_result")
+    Futures from one BatchingCommitProxy share its completion condition
+    instead of carrying a private threading.Event each: a whole batch
+    resolves together, so one notify_all per batch wakes every waiter —
+    the per-commit Event (allocation + lock dance on both set and wait)
+    was measurable e2e overhead at tens of thousands of commits/sec.
+    A standalone future (no proxy) must be ``set`` before ``result`` is
+    awaited — the pattern of every standalone construction site
+    (read-only fast paths, fault wrappers resolve immediately)."""
 
-    def __init__(self):
-        self._event = threading.Event()
-        self._result = None
+    __slots__ = ("_result", "_proxy")
+
+    def __init__(self, proxy=None):
+        self._result = _UNSET
+        self._proxy = proxy
 
     def done(self):
-        return self._event.is_set()
+        return self._result is not _UNSET
 
     def set(self, result):
         self._result = result
-        self._event.set()
 
     def result(self, timeout=None):
         """Block until resolved (thread mode); returns version or FDBError."""
-        if not self._event.wait(timeout):
-            raise TimeoutError("commit future not resolved")
+        if self._result is not _UNSET:
+            return self._result
+        if self._proxy is None:
+            raise TimeoutError("standalone commit future never resolved")
+        cond = self._proxy._done_cond
+        with cond:
+            if not cond.wait_for(self.done, timeout):
+                raise TimeoutError("commit future not resolved")
         return self._result
 
 
@@ -75,6 +92,7 @@ class BatchingCommitProxy:
         self._pending = []  # [(request, future)]
         self._first_pending_step = None
         self._wake = threading.Condition(self._lock)
+        self._done_cond = threading.Condition()  # batch-completion waiters
         self._closed = False
         self.batches_committed = 0
         self.txns_batched = 0
@@ -91,7 +109,7 @@ class BatchingCommitProxy:
     # ────────────────────────── client surface ──────────────────────────
     def submit(self, request):
         """Enqueue a commit; returns a CommitFuture."""
-        fut = CommitFuture()
+        fut = CommitFuture(self)
         with self._lock:
             if self._closed:
                 raise RuntimeError("batching proxy is closed")
@@ -215,6 +233,8 @@ class BatchingCommitProxy:
         self.max_batch_seen = max(self.max_batch_seen, len(chunk))
         for (_, fut), res in zip(chunk, results):
             fut.set(res)
+        with self._done_cond:  # ONE wakeup for the whole batch
+            self._done_cond.notify_all()
 
     def _fail_chunks(self, chunks, e):
         self.last_batch_error = e
@@ -222,6 +242,8 @@ class BatchingCommitProxy:
             for _, fut in chunk:
                 fut.set(e if isinstance(e, FDBError) else
                         FDBError.from_name("commit_unknown_result"))
+        with self._done_cond:
+            self._done_cond.notify_all()
 
     def _batcher_loop(self):
         while True:
@@ -253,6 +275,8 @@ class BatchingCommitProxy:
             self._first_pending_step = None
         for _, fut in pending:
             fut.set(error)
+        with self._done_cond:
+            self._done_cond.notify_all()
 
     def close(self):
         with self._lock:

@@ -272,6 +272,26 @@ class CommitProxy:
     def _commit_batch_admitted(self, requests):
         """The batch pipeline past the idempotency dedupe (every entry
         route runs the dedupe exactly once before landing here)."""
+        rk = self.ratekeeper
+        if rk is not None and rk.target_tps < rk.UNLIMITED_TPS:
+            # rv-None requests skipped the GRV (read-free fast path);
+            # under a CONSTRAINED budget they pay admission here
+            # instead — same token bucket, same retryable 1037. The
+            # gate assigns the rv on admission, so the sub-batch
+            # re-entry through _partition_rejects cannot double-charge.
+            rv_now = self.sequencer.committed_version
+
+            def gate(r):
+                if r.read_version is not None:
+                    return None
+                if rk.admit():
+                    r.read_version = rv_now
+                    return None
+                return "process_behind"
+
+            out = self._partition_rejects(requests, gate)
+            if out is not None:
+                return out
         lock_uid = getattr(self, "lock_uid", None)
         if lock_uid is not None:
             # database locked (ref: lockDatabase / error 1038): only
@@ -415,9 +435,17 @@ class CommitProxy:
         # whose dedupe answers the duplicate its original version.
         # Degrading the whole backlog on a match trades throughput for
         # simplicity exactly once per retry, not steady-state.
+        rk = self.ratekeeper
         if any(getattr(r, "idempotency_id", None)
                and self._idmp_lookup(r.idempotency_id) is not None
-               for reqs in request_batches for r in reqs):
+               for reqs in request_batches for r in reqs) or (
+            # a constrained budget gates rv-None requests at admission
+            # (the per-batch path runs that gate); overload throughput
+            # is moot, so losing the backlog pipelining there is fine
+            rk is not None and rk.target_tps < rk.UNLIMITED_TPS
+            and any(r.read_version is None
+                    for reqs in request_batches for r in reqs)
+        ):
             out = []
             try:
                 for reqs in request_batches:
@@ -486,16 +514,48 @@ class CommitProxy:
                 self.log_gate.advance(last_cv)
 
     def _build_txns(self, requests):
-        return [
-            TxnRequest(
+        rv_assigned = None
+        n_lazy = 0
+        for r in requests:
+            if r.read_version is None:
+                # read-free txn (no read conflict ranges): the client
+                # skipped its GRV and the proxy assigns the window
+                # position — the resolver never compares anything
+                # against a read-free txn's rv (see Transaction.
+                # _build_commit_request)
+                if rv_assigned is None:
+                    rv_assigned = self.sequencer.committed_version
+                r.read_version = rv_assigned
+                n_lazy += 1
+        if n_lazy and self.ratekeeper is not None:
+            # they bypassed the GRV's admission sampling: feed the
+            # busy-tag base or tagged share reads inflated
+            self.ratekeeper.note_untagged_admissions(n_lazy)
+        if not all(getattr(r_, "wants_point_split", True)
+                   for r_ in self.resolvers):
+            # host backends: a point IS its tiny range — hand the
+            # client's ranges through untouched (both byte strings
+            # already exist; the split bought nothing but CPU)
+            return [
+                TxnRequest(
+                    read_version=r.read_version,
+                    point_reads=(), point_writes=(),
+                    range_reads=r.read_conflict_ranges,
+                    range_writes=r.write_conflict_ranges,
+                )
+                for r in requests
+            ]
+        split = _split_ranges
+        out = []
+        for r in requests:
+            pr, rr = split(r.read_conflict_ranges)
+            pw, rw = split(r.write_conflict_ranges)
+            out.append(TxnRequest(
                 read_version=r.read_version,
-                point_reads=_points(r.read_conflict_ranges),
-                point_writes=_points(r.write_conflict_ranges),
-                range_reads=_true_ranges(r.read_conflict_ranges),
-                range_writes=_true_ranges(r.write_conflict_ranges),
-            )
-            for r in requests
-        ]
+                point_reads=pr, point_writes=pw,
+                range_reads=rr, range_writes=rw,
+            ))
+        return out
 
     def _finalize_batch(self, requests, txns, statuses, cv, window,
                         prev=None):
@@ -829,18 +889,23 @@ class CommitProxy:
         return lo, hi
 
 
-def _points(ranges):
-    """Single-key conflict ranges [k, k+\\x00) routed to the resolver's
-    point lanes — O(1) hash-table checks on device instead of the range
-    lanes' ring scans. The reference makes the same point/range
-    distinction inside detectConflicts (SkipList point queries vs range
-    walks); semantics are identical either way (a point op IS the tiny
-    range), this is purely the fast path."""
-    return [b for b, e in ranges if e == b + b"\x00"]
-
-
-def _true_ranges(ranges):
-    return [(b, e) for b, e in ranges if e != b + b"\x00"]
+def _split_ranges(ranges):
+    """One pass splitting conflict ranges into (points, true_ranges).
+    Single-key ranges [k, k+\\x00) go to the resolver's point lanes —
+    O(1) hash-table checks on device instead of the range lanes' ring
+    scans. The reference makes the same point/range distinction inside
+    detectConflicts (SkipList point queries vs range walks); semantics
+    are identical either way (a point op IS the tiny range), this is
+    purely the fast path. The point test allocates nothing — comparing
+    against ``b + b"\\x00"`` built a bytes object per range and was the
+    single hottest line of the commit pipeline."""
+    points, true_ranges = [], []
+    for b, e in ranges:
+        if len(e) == len(b) + 1 and e[-1] == 0 and e.startswith(b):
+            points.append(b)
+        else:
+            true_ranges.append((b, e))
+    return points, true_ranges
 
 
 def _clip_points(keys, lo, hi):

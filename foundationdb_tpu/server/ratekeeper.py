@@ -106,6 +106,15 @@ class Ratekeeper:
             self._note_admit_locked(tags)
             return True, None
 
+    def note_untagged_admissions(self, n):
+        """Read-free commits skip the GRV (rv assigned at the proxy)
+        but still belong in the busy-tag sample's admissions BASE:
+        without them ``cnt/total`` overstates every tag's share and
+        auto-throttling turns against innocent tags (round-5 review).
+        Called once per batch, under the lock."""
+        with self._mu:
+            self._recent_admits += n
+
     def tag_gate(self, tags):
         """The tag half alone (BatchingGrvProxy closes tag gates before
         queueing so a throttled tag never occupies the shared FIFO; the

@@ -159,16 +159,35 @@ class StorageServer(RangeReadInterface):
 
     # ───────────────────────────── writes ──────────────────────────────
     def apply(self, version, mutations):
-        """Apply one commit's mutations at ``version`` (monotone order)."""
+        """Apply one commit's mutations at ``version`` (monotone order).
+
+        The SET case is inlined (no _append call): it is the bulk of
+        every write-heavy batch and this loop runs on the batcher
+        thread for the WHOLE cluster — its per-mutation cost is a
+        direct throughput tax on the commit pipeline."""
         if version <= self.version:
             raise ValueError(f"apply out of order: {version} <= {self.version}")
         with self._mu:
+            overlay_get = self._overlay.get
+            overlay = self._overlay
+            dirty_append = self._dirty.append
+            watches = self._watches
             for m in mutations:
-                if m.op is Op.CLEAR_RANGE:
+                op = m.op
+                if op is Op.SET:
+                    key = m.key
+                    chain = overlay_get(key)
+                    if chain is None:
+                        overlay[key] = chain = []
+                    chain.append((version, m.param))
+                    dirty_append((version, key))
+                    if watches:
+                        self._fire_watches(key, m.param)
+                elif op is Op.CLEAR_RANGE:
                     self._apply_clear_range(m.key, m.param, version)
-                elif m.op in (Op.SET, Op.CLEAR):
-                    self._append(m.key, version, m.param if m.op is Op.SET else None)
-                elif m.op in ATOMIC_OPS:
+                elif op is Op.CLEAR:
+                    self._append(m.key, version, None)
+                elif op in ATOMIC_OPS:
                     old = self._lookup(m.key, version)
                     self._append(m.key, version, apply_atomic(m.op, old, m.param))
                 else:
@@ -190,11 +209,16 @@ class StorageServer(RangeReadInterface):
             self._overlay[key] = chain
         chain.append((version, value))
         self._dirty.append((version, key))
-        for w in self._watches.get(key, []):
-            if value != w.seen_value:
-                w._fire()
-        if self._watches.get(key):
-            self._watches[key] = [w for w in self._watches[key] if not w.fired]
+        if self._watches:
+            self._fire_watches(key, value)
+
+    def _fire_watches(self, key, value):
+        watchers = self._watches.get(key)
+        if watchers:
+            for w in watchers:
+                if value != w.seen_value:
+                    w._fire()
+            self._watches[key] = [w for w in watchers if not w.fired]
 
     def flush(self, up_to_version=None):
         """Make versions ≤ ``up_to_version`` durable: fold the newest

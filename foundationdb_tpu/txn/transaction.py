@@ -356,14 +356,23 @@ class Transaction:
             raise err("transaction_too_large")
 
     def set(self, key, value):
+        # the hottest client call: helpers (_log_mutation,
+        # _add_write_conflict, key_successor) are inlined — at tens of
+        # thousands of commits/sec their call overhead was measurable
         self._guard()
         key, value = _check_key(key), _check_value(value)
         if key.startswith(b"\xff") and specialkeys.contains(key):
             specialkeys.write(self, key, value)
             return
         self._writes.set(key, value)
-        self._log_mutation(Mutation(Op.SET, key, value))
-        self._add_write_conflict(key, key_successor(key))
+        self._mutation_log.append(Mutation(Op.SET, key, value))
+        self._size += len(key) + len(value)
+        if self._size > self._knobs.transaction_size_limit:
+            raise err("transaction_too_large")
+        if self._next_write_no_conflict:
+            self._next_write_no_conflict = False
+        else:
+            self._write_conflicts.append((key, key + b"\x00"))
 
     def clear(self, key):
         self._guard()
@@ -500,7 +509,23 @@ class Transaction:
 
     # ─────────────────────────── commit ───────────────────────────────
     def _build_commit_request(self):
-        rv = self.get_read_version()
+        # Lazy read version for READ-FREE transactions: with no read
+        # conflict ranges the resolver never compares anything against
+        # rv — it only places the txn inside the MVCC window — so the
+        # PROXY assigns its current committed version at batch time
+        # (read_version=None on the wire). Write-only traffic thus
+        # skips the GRV round trip entirely: over a remote transport
+        # that round trip was the single largest per-txn cost. A txn
+        # that ever read (or pinned a version) keeps its honest rv, and
+        # TAGGED txns always pay the GRV — per-tag throttling is
+        # enforced at that gate (skipping it would let a throttled tag
+        # write unthrottled); the untagged global budget is enforced at
+        # the proxy for rv-None requests instead.
+        if (self._read_version is None and not self._read_conflicts
+                and not self._tags):
+            rv = None
+        else:
+            rv = self.get_read_version()
         return CommitRequest(
             read_version=rv,
             mutations=list(self._mutation_log),

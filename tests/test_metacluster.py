@@ -184,6 +184,36 @@ def test_move_resumes_after_crash(meta, crash_after, monkeypatch):
     assert mc2.list_tenants()[b"frag"]["cluster"] == "dc2"
 
 
+def test_delete_mid_move_refused(meta, monkeypatch):
+    """Deleting a tenant with two partial copies (mid-move) is refused
+    retryably — finishing the move first is the only safe path (a
+    cleared registry row would let a later same-name create resurrect
+    the orphaned destination copy)."""
+    mc, d1, d2 = meta
+    mc.create_tenant(b"mm")
+    src_prefix = d1.run(lambda tr: tr.get(b"\xff/tenant/map/mm"))
+    mc._set_assignment(b"mm", b"dc1", "moving", src_prefix=src_prefix,
+                       dst=b"dc2")
+    with pytest.raises(FDBError) as ei:
+        mc.delete_tenant(b"mm")
+    assert ei.value.code == 2144 and ei.value.is_retryable
+    mc.resume_move(b"mm")
+    mc.delete_tenant(b"mm")  # now clean
+    assert b"mm" not in mc.list_tenants()
+
+
+def test_move_refuses_full_destination(meta):
+    mc, d1, d2 = meta
+    # fill dc2 (capacity 2)
+    placed = [mc.create_tenant(b"f%d" % i) for i in range(4)]
+    victim = b"f%d" % placed.index(b"dc1")  # a dc1 tenant
+    with pytest.raises(FDBError) as ei:
+        mc.move_tenant(victim, b"dc2")
+    assert ei.value.code == 2166
+    dcs = mc.list_data_clusters()
+    assert dcs[b"dc2"]["tenants"] <= dcs[b"dc2"]["capacity"]
+
+
 def test_register_failure_rolls_back_cleanly(meta):
     """A data cluster that refuses its mark (already in a metacluster)
     must not leave a registry row behind; and the refused cluster is

@@ -337,3 +337,53 @@ def test_remote_cluster_with_auth():
     finally:
         server.close()
         cluster.close()
+
+
+def test_grv_coalescing_leader_failure_releases_waiters():
+    """Regression (round-5 review): a failed leader GRV round must
+    release EVERY registered waiter (they fall back to direct calls) —
+    not strand threads waiting on rounds no surviving leader will run."""
+    import threading
+    import time as _time
+
+    from foundationdb_tpu.rpc.service import _CoalescingGrvProxy
+
+    class FakeRC:
+        def __init__(self):
+            self.calls = 0
+            self.gate = threading.Event()
+
+        def _call(self, method, *args):
+            self.calls += 1
+            if self.calls == 1:
+                self.gate.wait(5)  # hold round 1 until waiters register
+                raise OSError("tunnel died")
+            return 42
+
+    rc = FakeRC()
+    grv = _CoalescingGrvProxy(rc)
+    results, errors = [], []
+
+    def leader():
+        try:
+            results.append(grv.get_read_version())
+        except Exception as e:
+            errors.append(e)
+
+    def waiter():
+        results.append(grv.get_read_version())
+
+    tl = threading.Thread(target=leader)
+    tl.start()
+    _time.sleep(0.1)  # leader is mid-flight
+    tws = [threading.Thread(target=waiter) for _ in range(3)]
+    for t in tws:
+        t.start()
+    _time.sleep(0.1)  # waiters registered for the next round
+    rc.gate.set()  # leader's rpc now fails
+    tl.join(timeout=5)
+    for t in tws:
+        t.join(timeout=5)
+        assert not t.is_alive(), "waiter stranded after leader failure"
+    assert len(errors) == 1  # the leader saw the failure
+    assert results == [42, 42, 42]  # waiters fell back to direct calls
