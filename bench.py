@@ -735,7 +735,10 @@ def run_e2e_multiproc(seconds=None, n_clients=None):
     n_clients = n_clients or int(os.environ.get("BENCH_E2E_MP_CLIENTS", 4))
     d = tempfile.mkdtemp(prefix="bench-mp-")
     cf = os.path.join(d, "fdb.cluster")
-    n_workers = int(os.environ.get("BENCH_E2E_MP_WORKERS", 2))
+    n_workers = int(os.environ.get("BENCH_E2E_MP_WORKERS", 0))
+    # measured: read workers HURT this config (they lag behind the write
+    # stream and fall back to the lead anyway, adding pull load); they
+    # remain available for read-heavy shapes via the env knob
     server = subprocess.Popen(
         [sys.executable, "-m", "foundationdb_tpu.tools.fdbserver",
          "--listen", "127.0.0.1:0", "--cluster-file", cf,
