@@ -59,11 +59,15 @@ def format_key(b):
 
 
 class Cli:
-    def __init__(self, db, out=None):
+    def __init__(self, db, out=None, open_fn=None):
         self.db = db
         self.out = out if out is not None else sys.stdout
         self.tr = None  # explicit transaction when `begin` is active
         self.write_mode = False
+        # metacluster commands open OTHER clusters by cluster file;
+        # tests inject an opener that returns in-process databases
+        self._open_fn = open_fn
+        self._metacluster = None
 
     def _p(self, *lines):
         for ln in lines:
@@ -108,6 +112,11 @@ class Cli:
             self._p(f"ERROR: {e} ({e.code})")
         except (ValueError, IndexError) as e:
             self._p(f"ERROR: {e}")
+        except OSError as e:
+            # bad cluster file / unreachable peer (metacluster register
+            # etc.) must not kill the shell — ConnectionLost and
+            # FileNotFoundError are both OSErrors
+            self._p(f"ERROR: {e}")
         return True
 
     # ── commands (ref: fdbcli command table) ──
@@ -128,6 +137,7 @@ class Cli:
             "  tenant mode [MODE]              optional|required|disabled",
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
+            "  metacluster create|status|register|attach|remove|tenant",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
@@ -291,6 +301,91 @@ class Cli:
 
     def _cmd_option(self, args):
         self._p("Option enabled for all transactions")
+
+    def _open_cluster(self, cluster_file):
+        if self._open_fn is not None:
+            return self._open_fn(cluster_file)
+        import foundationdb_tpu as fdb
+
+        return fdb.open(cluster_file=cluster_file)
+
+    def _mc(self):
+        from foundationdb_tpu.layers.metacluster import Metacluster
+
+        if self._metacluster is None:
+            self._metacluster = Metacluster(self.db)
+        return self._metacluster
+
+    def _cmd_metacluster(self, args):
+        """Ref: the fdbcli `metacluster` command family
+        (MetaclusterCommands.actor.cpp): create the management cluster,
+        register/attach/remove data clusters, place and move tenants."""
+        from foundationdb_tpu.layers.metacluster import Metacluster
+
+        sub = args[0] if args else "status"
+        if sub == "create":
+            self._metacluster = Metacluster.create(
+                self.db, parse_key(args[1]) if len(args) > 1 else b"meta")
+            self._p("The metacluster has been created")
+        elif sub == "register":
+            name = parse_key(args[1])
+            capacity = int(args[3]) if len(args) > 3 else 100
+            self._mc().register_data_cluster(
+                name, self._open_cluster(args[2]), capacity=capacity)
+            self._p(f"The data cluster `{args[1]}' has been registered")
+        elif sub == "attach":
+            self._mc().attach_data_cluster(
+                parse_key(args[1]), self._open_cluster(args[2]))
+            self._p(f"The data cluster `{args[1]}' has been attached")
+        elif sub == "remove":
+            name = parse_key(args[1])
+            mc = self._mc()
+            if name not in mc.databases and len(args) > 2:
+                mc.attach_data_cluster(name, self._open_cluster(args[2]))
+            if name not in mc.databases:
+                # removing unattached would clear the registry row but
+                # leave the data-side mark, bricking re-registration
+                self._p("ERROR: data cluster not attached — use "
+                        "`metacluster remove NAME CLUSTER_FILE'")
+                return
+            mc.remove_data_cluster(name)
+            self._p(f"The data cluster `{args[1]}' has been removed")
+        elif sub == "status":
+            mc = self._mc()
+            clusters = mc.list_data_clusters()
+            tenants = mc.list_tenants()
+            self._p(f"metacluster: {len(clusters)} data cluster(s), "
+                    f"{len(tenants)} tenant(s)")
+            for name, meta in sorted(clusters.items()):
+                self._p(f"  {format_key(name)}: "
+                        f"{meta['tenants']}/{meta['capacity']} tenants")
+        elif sub == "tenant":
+            op = args[1]
+            mc = self._mc()
+            if op == "create":
+                cluster = mc.create_tenant(parse_key(args[2]))
+                self._p(f"The tenant `{args[2]}' has been created on "
+                        f"`{format_key(cluster)}'")
+            elif op == "delete":
+                mc.delete_tenant(parse_key(args[2]))
+                self._p(f"The tenant `{args[2]}' has been deleted")
+            elif op == "list":
+                for name, a in sorted(mc.list_tenants().items()):
+                    owner = format_key(a["cluster"].encode("latin-1"))
+                    self._p(f"  {format_key(name)} -> {owner}"
+                            + ("" if a["state"] == "ready"
+                               else f" ({a['state']})"))
+            elif op == "move":
+                mc.move_tenant(parse_key(args[2]), parse_key(args[3]))
+                self._p(f"The tenant `{args[2]}' has been moved to "
+                        f"`{args[3]}'")
+            elif op == "resume":
+                mc.resume_move(parse_key(args[2]))
+                self._p(f"The tenant `{args[2]}' move has been resumed")
+            else:
+                self._p(f"ERROR: unknown metacluster tenant op `{op}'")
+        else:
+            self._p(f"ERROR: unknown metacluster subcommand `{sub}'")
 
     def _cmd_tenant(self, args):
         from foundationdb_tpu.layers.tenant import TenantManagement as TM

@@ -255,3 +255,46 @@ def test_create_tenant_resumes_registering_state(meta, monkeypatch):
     assert t[b"k"] == b"v"
     # capacity was consumed exactly once
     assert mc.list_data_clusters()[cluster]["tenants"] == 1
+
+
+def test_fdbcli_metacluster_commands(tmp_path):
+    """The fdbcli `metacluster` family (ref: MetaclusterCommands):
+    create, register by cluster file, tenant placement/move, status."""
+    import io
+
+    from foundationdb_tpu.tools.cli import Cli
+
+    clusters = {f"/cf/{n}": Cluster(resolver_backend="cpu", **TEST_KNOBS)
+                for n in ("d1", "d2")}
+    mgmt = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        out = io.StringIO()
+        cli = Cli(mgmt.database(), out=out,
+                  open_fn=lambda cf: clusters[cf].database())
+        for line in (
+            "metacluster create",
+            "metacluster register east /cf/d1 4",
+            "metacluster register west /cf/d2 4",
+            "metacluster tenant create acme",
+            "metacluster tenant move acme west",
+            "metacluster tenant list",
+            "metacluster status",
+        ):
+            assert cli.run_command(line)
+        text = out.getvalue()
+        assert "has been registered" in text
+        assert "acme -> west" in text
+        assert "2 data cluster(s), 1 tenant(s)" in text
+        # the move really happened on the data clusters
+        assert clusters["/cf/d1"].database().get_range(b"\xfd", b"\xfe") == []
+        out2 = io.StringIO()
+        cli2 = Cli(mgmt.database(), out=out2,
+                   open_fn=lambda cf: clusters[cf].database())
+        cli2.run_command("metacluster attach east /cf/d1")
+        cli2.run_command("metacluster attach west /cf/d2")
+        cli2.run_command("metacluster tenant delete acme")
+        assert "has been deleted" in out2.getvalue()
+    finally:
+        mgmt.close()
+        for c in clusters.values():
+            c.close()
