@@ -63,6 +63,7 @@ def build_cluster(args, coordination=None):
     return Cluster(
         n_storage=args.storage,
         n_resolvers=args.resolvers,
+        n_commit_proxies=getattr(args, "commit_proxies", 1),
         n_tlogs=args.tlogs,
         replication=args.replication,
         fsync=args.fsync,
@@ -101,6 +102,10 @@ def main(argv=None):
                         "disk-resident versioned engine; disk kinds "
                         "need --dir)")
     p.add_argument("--resolvers", type=int, default=1)
+    p.add_argument("--commit-proxies", type=int, default=1,
+                   help="commit-proxy fleet size (sequencer-chained "
+                        "version grants; ref: the proxy count in "
+                        "`configure`)")
     p.add_argument("--tlogs", type=int, default=1)
     p.add_argument("--replication", type=int, default=None)
     p.add_argument("--fsync", action="store_true")
