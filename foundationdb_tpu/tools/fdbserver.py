@@ -63,7 +63,7 @@ def build_cluster(args, coordination=None):
     return Cluster(
         n_storage=args.storage,
         n_resolvers=args.resolvers,
-        n_commit_proxies=getattr(args, "commit_proxies", 1),
+        n_commit_proxies=args.commit_proxies,
         n_tlogs=args.tlogs,
         replication=args.replication,
         fsync=args.fsync,

@@ -425,6 +425,10 @@ def test_fleet_over_rpc_with_batched_commits(tmp_path):
             fut.result(timeout=30)
             tr.commit_finish(fut)
         assert len(db.get_range(b"blind", b"bline")) == 50
+        db._cluster.close()  # release the socket so SIGTERM lands clean
     finally:
         p.send_signal(signal.SIGTERM)
-        p.wait(timeout=20)
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
