@@ -124,6 +124,7 @@ class ClusterService:
             "unlock_database": self.cluster.unlock_database,
             "lock_uid": self.cluster.lock_uid,
             "set_tenant_mode": self.cluster.set_tenant_mode,
+            "configure": self._configure,
             "tenant_mode": self.cluster.tenant_mode,
             "set_tag_quota": self.cluster.set_tag_quota,
             "feed_register": self.cluster.change_feeds.register,
@@ -172,6 +173,11 @@ class ClusterService:
             with self._commit_lock:
                 return self.cluster.commit_proxy.commit(request)
         return self.cluster.commit_proxy.commit(request)
+
+    def _configure(self, commit_proxies=None):
+        """Live reconfiguration over the wire (fdbcli `configure`)."""
+        self.cluster.configure(commit_proxies=commit_proxies)
+        return self.cluster.n_commit_proxies
 
     def commit_batch(self, requests):
         """A client-batched window of commits in ONE RPC (the remote
@@ -638,6 +644,9 @@ class RemoteCluster:
 
     def set_tenant_mode(self, mode):
         return self._call("set_tenant_mode", mode)
+
+    def configure(self, commit_proxies=None):
+        return self._call("configure", commit_proxies)
 
     def tenant_mode(self):
         return self._call("tenant_mode")

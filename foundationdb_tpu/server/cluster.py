@@ -11,6 +11,7 @@ process for deterministic testing.
 
 import dataclasses
 import itertools
+import threading
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
@@ -199,6 +200,11 @@ class Cluster:
         self._commit_flush_after = commit_flush_after
         self.recruitments = 0  # roles replaced by the failure monitor
         self.n_commit_proxies = n_commit_proxies
+        # serializes txn-system recoveries: configure() arrives on an
+        # RPC worker thread while the failure monitor ticks on the main
+        # thread — two concurrent _recover_txn_system calls would race
+        # the generation CAS and tear the frontend swap
+        self._recovery_mu = threading.Lock()
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()
@@ -326,9 +332,14 @@ class Cluster:
             # system recovery: new generation through the coordination
             # CAS, resolvers fenced, fresh sequencer/proxies — WITHOUT
             # touching storage or the logs (ref: ClusterRecovery
-            # recruiting a new txn-system generation)
-            self._recover_txn_system()
-            events.append(("txn-system", 0))
+            # recruiting a new txn-system generation). Liveness is
+            # re-checked under the recovery mutex: a configure() racing
+            # on another thread may already have rebuilt the frontend.
+            with self._recovery_mu:
+                if (not self.sequencer.alive
+                        or not self._commit_target().alive):
+                    self._recover_txn_system()
+                    events.append(("txn-system", 0))
         if isinstance(self.tlog, TLogSystem):
             for i, log in enumerate(self.tlog.logs):
                 if not log.alive and self.tlog.revive(i) is not None:
@@ -601,6 +612,21 @@ class Cluster:
         """The proxy that actually runs commit_batch (unwrap the
         batching pipeline wrapper) — lock state lives there."""
         return getattr(self.commit_proxy, "inner", self.commit_proxy)
+
+    def configure(self, commit_proxies=None):
+        """Live reconfiguration (ref: fdbcli `configure proxies=N` →
+        ManagementAPI changeConfig forcing a recovery): resizing the
+        commit-proxy fleet rides the ordinary txn-system recovery — a
+        new generation with the new fleet size over the same storage
+        and logs; in-flight clients ride it out on retryable errors."""
+        if commit_proxies is not None:
+            commit_proxies = int(commit_proxies)
+            if commit_proxies < 1:
+                raise err("invalid_option_value")
+            with self._recovery_mu:
+                if commit_proxies != self.n_commit_proxies:
+                    self.n_commit_proxies = commit_proxies
+                    self._recover_txn_system()
 
     def lock_database(self, uid=b"lock"):
         """Ref: ManagementAPI lockDatabase — commits from transactions

@@ -138,6 +138,7 @@ class Cli:
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  metacluster create|status|register|attach|remove|tenant",
+            "  configure commit_proxies=N      resize the proxy fleet",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
@@ -298,6 +299,24 @@ class Cli:
             self._p(f"Consistency check: FAIL ({len(errors)} errors)")
             for e in errors[:20]:
                 self._p(f"  {e}")
+
+    def _cmd_configure(self, args):
+        """Ref: fdbcli `configure` → changeConfig. Supported:
+        commit_proxies=N (a txn-system recovery installs the new fleet
+        size over the same storage and logs)."""
+        kw = {}
+        for a in args:
+            k, _, v = a.partition("=")
+            if k in ("commit_proxies", "proxies") and v:
+                kw["commit_proxies"] = int(v)
+            else:
+                self._p(f"ERROR: unsupported configure option `{a}'")
+                return
+        if not kw:
+            self._p("ERROR: nothing to configure")
+            return
+        self.db._cluster.configure(**kw)
+        self._p("Configuration changed")
 
     def _cmd_option(self, args):
         self._p("Option enabled for all transactions")

@@ -432,3 +432,70 @@ def test_fleet_over_rpc_with_batched_commits(tmp_path):
             p.wait(timeout=20)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+def test_configure_resizes_fleet_live(fleet_cluster):
+    """Ref: fdbcli `configure proxies=N` — a live resize rides the
+    txn-system recovery: new fleet size, same storage/logs, data and
+    lock state intact."""
+    c = fleet_cluster
+    db = c.database()
+    db[b"before"] = b"1"
+    gen0 = c.generation
+    c.configure(commit_proxies=5)
+    assert c.generation > gen0
+    assert len(c.commit_proxy.inners) == 5
+    assert db[b"before"] == b"1"
+    db[b"after"] = b"2"
+    assert db[b"after"] == b"2"
+    c.configure(commit_proxies=1)  # shrink to a single proxy
+    assert not hasattr(c.commit_proxy, "inners")
+    db[b"single"] = b"3"
+    assert db[b"single"] == b"3"
+    c.configure(commit_proxies=1)  # no-op: same size, no recovery
+    gen_now = c.generation
+    c.configure(commit_proxies=1)
+    assert c.generation == gen_now
+
+
+def test_configure_over_rpc_and_cli(tmp_path):
+    """`configure commit_proxies=N` through fdbcli against a remote
+    cluster (the management RPC)."""
+    import io
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    import foundationdb_tpu as fdb
+    from foundationdb_tpu.tools.cli import Cli
+
+    cf = str(tmp_path / "fdb.cluster")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": ""}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.fdbserver",
+         "--listen", "127.0.0.1:0", "--cluster-file", cf,
+         "--resolver-backend", "cpu"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        assert "FDBD listening" in p.stdout.readline()
+        db = fdb.open(cluster_file=cf)
+        out = io.StringIO()
+        cli = Cli(db, out=out)
+        cli.run_command("writemode on")
+        cli.run_command("set k v")
+        cli.run_command("configure commit_proxies=3")
+        assert "Configuration changed" in out.getvalue()
+        st = db._cluster.status()["cluster"]
+        assert st["processes"]["commit_proxy"]["count"] == 3
+        assert db[b"k"] == b"v"  # data survived the live recovery
+        db[b"post"] = b"w"
+        assert db[b"post"] == b"w"
+        db._cluster.close()
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
