@@ -174,10 +174,11 @@ class ClusterService:
                 return self.cluster.commit_proxy.commit(request)
         return self.cluster.commit_proxy.commit(request)
 
-    def _configure(self, commit_proxies=None):
-        """Live reconfiguration over the wire (fdbcli `configure`)."""
-        self.cluster.configure(commit_proxies=commit_proxies)
-        return self.cluster.n_commit_proxies
+    def _configure(self, commit_proxies=None, resolvers=None):
+        """Live reconfiguration over the wire (fdbcli `configure`);
+        returns the achieved shape so a remote operator can confirm."""
+        return self.cluster.configure(commit_proxies=commit_proxies,
+                                      resolvers=resolvers)
 
     def commit_batch(self, requests):
         """A client-batched window of commits in ONE RPC (the remote
@@ -645,8 +646,8 @@ class RemoteCluster:
     def set_tenant_mode(self, mode):
         return self._call("set_tenant_mode", mode)
 
-    def configure(self, commit_proxies=None):
-        return self._call("configure", commit_proxies)
+    def configure(self, commit_proxies=None, resolvers=None):
+        return self._call("configure", commit_proxies, resolvers)
 
     def tenant_mode(self):
         return self._call("tenant_mode")

@@ -366,14 +366,17 @@ class Cluster:
             TraceEvent("RolesRecruited").detail(events=events).log()
         return events
 
-    def _recover_txn_system(self):
+    def _recover_txn_system(self, new_resolver_lanes=None):
         """The recovery state machine for dead sequencer/commit-proxy
         roles (ref: fdbserver/ClusterRecovery.actor.cpp): win a new
         generation at the coordinators (CAS), restart the version
         authority above everything the log acked, fence the resolvers
         (their windows open at the recovery version, so pre-death read
         versions retry TOO_OLD), and recruit fresh proxies over the
-        SAME storages/logs — data is not torn down or re-ingested."""
+        SAME storages/logs — data is not torn down or re-ingested.
+        ``new_resolver_lanes`` (configure's resize) swaps the resolver
+        fleet shape HERE — after the quiesce, never while in-flight
+        commits could still resolve against the old history."""
         import contextlib
 
         old_proxy = self.commit_proxy
@@ -399,9 +402,28 @@ class Cluster:
             version_clock=self.sequencer.version_clock,
             start_version=recovered,
         )
-        # fence conflict history: in-flight txns retry with fresh reads
-        for i, r in enumerate(self.resolvers):
-            self.resolvers[i] = r.respawn(recovered)
+        # fence conflict history: in-flight txns retry with fresh reads.
+        # A resize builds the new shape directly at the recovery version
+        # (building earlier would both race in-flight resolution and be
+        # discarded by this very fence).
+        if new_resolver_lanes is None:
+            for i, r in enumerate(self.resolvers):
+                self.resolvers[i] = r.respawn(recovered)
+        else:
+            if self.knobs.resolver_backend == "tpu" \
+                    and new_resolver_lanes > 1:
+                from foundationdb_tpu.resolver.meshresolver import (
+                    MeshResolver,
+                )
+
+                new = [MeshResolver(self.knobs, base_version=recovered,
+                                    n_lanes=new_resolver_lanes)]
+            else:
+                new = [Resolver(self.knobs, base_version=recovered)
+                       for _ in range(new_resolver_lanes)]
+            # in place: the (old, quiesced) proxies share this list;
+            # the new frontend built below re-derives its ranges
+            self.resolvers[:] = new
         # the database lock and tenant mode are cluster state, not proxy
         # state: survive the recovery (ref: both living in the system
         # keyspace)
@@ -613,20 +635,44 @@ class Cluster:
         batching pipeline wrapper) — lock state lives there."""
         return getattr(self.commit_proxy, "inner", self.commit_proxy)
 
-    def configure(self, commit_proxies=None):
-        """Live reconfiguration (ref: fdbcli `configure proxies=N` →
-        ManagementAPI changeConfig forcing a recovery): resizing the
-        commit-proxy fleet rides the ordinary txn-system recovery — a
-        new generation with the new fleet size over the same storage
-        and logs; in-flight clients ride it out on retryable errors."""
-        if commit_proxies is not None:
-            commit_proxies = int(commit_proxies)
-            if commit_proxies < 1:
+    def resolver_lanes(self):
+        return sum(getattr(r, "n_lanes", 1) for r in self.resolvers)
+
+    def configure(self, commit_proxies=None, resolvers=None):
+        """Live reconfiguration (ref: fdbcli `configure proxies=N
+        resolvers=N` → ManagementAPI changeConfig forcing a recovery):
+        resizing the commit-proxy fleet or the resolver fleet rides the
+        ordinary txn-system recovery — a new generation with the new
+        sizes over the same storage and logs; in-flight clients ride it
+        out on retryable errors. New resolvers open FENCED at the
+        committed version (their empty conflict history cannot check
+        older read versions), exactly like recovery's respawn."""
+        for v in (commit_proxies, resolvers):
+            if v is not None and int(v) < 1:
                 raise err("invalid_option_value")
-            with self._recovery_mu:
-                if commit_proxies != self.n_commit_proxies:
-                    self.n_commit_proxies = commit_proxies
-                    self._recover_txn_system()
+        with self._recovery_mu:
+            changed = False
+            lanes = None
+            if (commit_proxies is not None
+                    and int(commit_proxies) != self.n_commit_proxies):
+                self.n_commit_proxies = int(commit_proxies)
+                changed = True
+            if resolvers is not None:
+                # compare against what was REQUESTED, not what the
+                # hardware achieved: the mesh clamps lanes to the
+                # device count, and a management loop re-applying its
+                # desired config must not force a fencing recovery on
+                # every pass
+                current = getattr(self, "_requested_resolver_lanes",
+                                  None) or self.resolver_lanes()
+                if int(resolvers) != current:
+                    lanes = int(resolvers)
+                    self._requested_resolver_lanes = lanes
+                    changed = True
+            if changed:
+                self._recover_txn_system(new_resolver_lanes=lanes)
+        return {"commit_proxies": self.n_commit_proxies,
+                "resolver_lanes": self.resolver_lanes()}
 
     def lock_database(self, uid=b"lock"):
         """Ref: ManagementAPI lockDatabase — commits from transactions

@@ -138,7 +138,7 @@ class Cli:
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  metacluster create|status|register|attach|remove|tenant",
-            "  configure commit_proxies=N      resize the proxy fleet",
+            "  configure commit_proxies=N resolvers=N   live resize",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
@@ -309,6 +309,8 @@ class Cli:
             k, _, v = a.partition("=")
             if k in ("commit_proxies", "proxies") and v:
                 kw["commit_proxies"] = int(v)
+            elif k == "resolvers" and v:
+                kw["resolvers"] = int(v)
             else:
                 self._p(f"ERROR: unsupported configure option `{a}'")
                 return

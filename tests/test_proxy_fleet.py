@@ -489,6 +489,9 @@ def test_configure_over_rpc_and_cli(tmp_path):
         assert "Configuration changed" in out.getvalue()
         st = db._cluster.status()["cluster"]
         assert st["processes"]["commit_proxy"]["count"] == 3
+        # a remote resolvers-only resize reports its achieved shape
+        shape = db._cluster.configure(resolvers=2)
+        assert shape == {"commit_proxies": 3, "resolver_lanes": 2}
         assert db[b"k"] == b"v"  # data survived the live recovery
         db[b"post"] = b"w"
         assert db[b"post"] == b"w"
@@ -499,3 +502,38 @@ def test_configure_over_rpc_and_cli(tmp_path):
             p.wait(timeout=20)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+def test_configure_resizes_resolvers_live(fleet_cluster):
+    """Ref: `configure resolvers=N` — fresh resolvers open FENCED at
+    the committed version; pre-resize read versions retry TOO_OLD, OCC
+    still bites after the resize, data intact."""
+    c = fleet_cluster
+    db = c.database()
+    for i in range(20):
+        db[b"k%02d" % i] = b"v"
+    stale = db.create_transaction()
+    assert stale.get(b"k00") == b"v"  # pins a pre-resize read version
+    stale[b"k00"] = b"stale"
+    for i in range(5):  # history the fresh resolvers can never check
+        db[b"post-pin%d" % i] = b"w"
+    c.configure(resolvers=3)
+    assert len(c.resolvers) == 3
+    assert db[b"k00"] == b"v"
+    with pytest.raises(FDBError) as ei:
+        stale.commit()  # fenced by the fresh resolvers
+    assert ei.value.code in (1007, 1020)
+    # OCC across the resized fleet: a classic race still conflicts
+    t1 = db.create_transaction()
+    t2 = db.create_transaction()
+    assert t1.get(b"k01") == t2.get(b"k01") == b"v"
+    t1[b"k01"] = b"a"
+    t2[b"k01"] = b"b"
+    t1.commit()
+    with pytest.raises(FDBError) as ei2:
+        t2.commit()
+    assert ei2.value.code == 1020
+    c.configure(resolvers=1)
+    assert len(c.resolvers) == 1
+    db[b"post"] = b"x"
+    assert db[b"post"] == b"x"
