@@ -13,7 +13,7 @@ import dataclasses
 import itertools
 import threading
 
-from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.resolver.resolver import Resolver
 from foundationdb_tpu.server.coordination import (
@@ -764,6 +764,32 @@ class Cluster:
 
         return Database(self)
 
+    def _metacluster_status(self):
+        """This cluster's metacluster membership (ref: the metacluster
+        section of status json): management/data role + name from the
+        registration row, or cluster_type "standalone"."""
+        import json as _json
+
+        from foundationdb_tpu.layers.metacluster import REGISTRATION_KEY
+
+        s0 = next((s for s in self.storages if s.alive), None)
+        if s0 is None:
+            # membership is UNREADABLE, not absent — claiming
+            # "standalone" with every storage dead would lie to an
+            # operator about a registered cluster
+            return {"cluster_type": "unknown"}
+        try:
+            row = s0.get(REGISTRATION_KEY, s0.version)
+        except FDBError:
+            # a kill raced past the alive check: status() reports
+            # chaos as data, it never raises
+            return {"cluster_type": "unknown"}
+        if row is None:
+            return {"cluster_type": "standalone"}
+        meta = _json.loads(row)
+        return {"cluster_type": f"metacluster_{meta['role']}",
+                "name": meta.get("name")}
+
     def status(self):
         """Cluster status summary (ref: fdbcli status json, Status.actor.cpp
         — processes/roles breakdown, qos, data, recovery state)."""
@@ -794,6 +820,7 @@ class Cluster:
                 },
                 "database_available": live_storages > 0,
                 "database_lock_state": _lock_state(self.lock_uid()),
+                "metacluster": self._metacluster_status(),
                 "change_feeds": len(self.change_feeds),
                 "degraded": degraded,
                 "recruitments": self.recruitments,

@@ -298,3 +298,24 @@ def test_fdbcli_metacluster_commands(tmp_path):
         mgmt.close()
         for c in clusters.values():
             c.close()
+
+
+def test_status_json_reports_metacluster_role(meta):
+    """Ref: the metacluster section of status json — each cluster
+    reports its membership role; standalone clusters say so."""
+    mc, d1, _ = meta
+    assert mc.db._cluster.status()["cluster"]["metacluster"] == {
+        "cluster_type": "metacluster_management", "name": "meta"}
+    assert d1._cluster.status()["cluster"]["metacluster"] == {
+        "cluster_type": "metacluster_data", "name": "dc1"}
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        assert c.status()["cluster"]["metacluster"] == {
+            "cluster_type": "standalone"}
+        # all storages dead: membership is UNREADABLE, never a lie
+        for s in c.storages:
+            s.kill()
+        assert c.status()["cluster"]["metacluster"] == {
+            "cluster_type": "unknown"}
+    finally:
+        c.close()
