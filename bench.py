@@ -505,6 +505,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         hash_table_bits=20 if not cpu else 15,
         range_ring_capacity=4096 if not cpu else 256,
         commit_batch_max=1024 if not cpu else 128,
+        # bounded multi-stage commit pipeline (server/batcher.py):
+        # pack+resolve of group N+1 overlaps the apply of group N
+        commit_pipeline_depth=int(env("BENCH_PIPELINE_DEPTH", 2)),
     )
     db = cluster.database()
     # warm the pipeline (first batch jit-compiles the resolver kernel,
@@ -645,6 +648,11 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "e2e_committed_txns": total,
         "e2e_conflict_rate": round(aborted / max(total + aborted, 1), 4),
         "e2e_backlog_target": getattr(bp, "_backlog_target", 1),
+        # per-stage commit-pipeline timings (pack = stage A+B on the
+        # batcher thread; resolve = the status-sync stall in stage C;
+        # apply = tlog push + storage apply + settlement) + occupancy —
+        # the next PR reads these to see which stage is critical-path
+        **(bp.stage_summary() if hasattr(bp, "stage_summary") else {}),
     }
 
 
@@ -1253,7 +1261,9 @@ def _compact_summary(out, configs):
     for k in ("platform", "device_kernel_txns_per_sec",
               "conflict_check_p99_ms", "kernel_step_ms",
               "pallas_kernel_step", "e2e_committed_txns_per_sec",
-              "e2e_proxies", "e2e_conflict_rate", "tpu_recovered",
+              "e2e_proxies", "e2e_conflict_rate",
+              "stage_pack_ms", "stage_resolve_ms", "stage_apply_ms",
+              "pipeline_depth_effective", "tpu_recovered",
               "fallback_from", "error"):
         if out.get(k) is not None:
             line[k] = out[k]
@@ -1281,7 +1291,8 @@ def main():
     # batches) ran >5 min on CPU in round 1 — long enough to look hung.
     cpu = platform == "cpu"
     mode = env("BENCH_MODE", "all")  # all | point | range |
-    # ring_capacity | sharded_e2e (internal: the multilane re-exec child)
+    # ring_capacity | pipeline_smoke (quick commit-pipeline regression
+    # probe) | sharded_e2e (internal: the multilane re-exec child)
     # only the default multi-config run plans recovery re-execs, so only
     # it earns the wider deadline (worst case 60+500+120+650s of
     # subprocess-bounded recovery work)
@@ -1315,6 +1326,39 @@ def main():
         _e2e_line(cpu, "e2e_committed_txns_per_sec_sharded",
                   n_resolvers=3, seconds=secondary_s)
         watchdog_finish()
+        return
+
+    if mode == "pipeline_smoke":
+        # Quick depth-1 vs pipelined comparison on the link-free local
+        # pipeline: a commit-pipeline regression (occupancy collapse, a
+        # stage newly critical-path) shows up as speedup_pipelined <= 1
+        # or a pipeline_depth_effective stuck at ~1 in the BENCH_*
+        # trajectory, without paying for the full multi-config run.
+        secs = float(env("BENCH_SMOKE_SECONDS", 2))
+        depth = int(env("BENCH_PIPELINE_DEPTH", 2))
+        runs = {}
+        for d in (1, depth):
+            os.environ["BENCH_PIPELINE_DEPTH"] = str(d)
+            try:
+                runs[d] = run_e2e(cpu, backend="native", seconds=secs)
+            except Exception as e:
+                sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                runs[d] = run_e2e(cpu, backend="cpu", seconds=secs)
+        watchdog_finish()
+        v1 = runs[1]["e2e_committed_txns_per_sec"]
+        v2 = runs[depth]["e2e_committed_txns_per_sec"]
+        _emit({
+            "metric": "e2e_pipeline_smoke", "value": v2,
+            "unit": "txns/sec",
+            "vs_baseline": round(v2 / BASELINE_TXNS_PER_SEC, 3),
+            "depth1_txns_per_sec": v1,
+            "speedup_pipelined": round(v2 / max(v1, 1e-9), 3),
+            "pipeline_depth": depth,
+            **{k: runs[depth][k] for k in
+               ("stage_pack_ms", "stage_resolve_ms", "stage_apply_ms",
+                "pipeline_depth_effective", "e2e_conflict_rate",
+                "e2e_backend", "platform") if k in runs[depth]},
+        })
         return
 
     if mode == "ring_capacity":

@@ -46,6 +46,12 @@ class Knobs:
     # --- proxy batching ---
     commit_batch_interval_s: float = 0.0005
     grv_batch_interval_s: float = 0.0005
+    # bounded commit-pipeline depth (server/batcher.py): how many backlog
+    # groups may be in flight at once — group N+1 packs on the host and
+    # dispatches its resolve while group N's tlog push + storage apply
+    # runs. 1 = the strictly serial loop (exactly the pre-pipeline
+    # behavior); manual/sim mode always runs depth 1 for determinism.
+    commit_pipeline_depth: int = 2
     # fleet VersionGate stall bound: a turn unclaimed this long means a
     # peer proxy died between grant and advance → 1021 + txn-system
     # recovery (tests shrink it; see server/proxy.py GateTimeout)

@@ -183,7 +183,11 @@ class NativeConflictSet:
         Packing is allocation-lean on the hot path: a POINT key k packs
         once as ``k\\x00`` and its end span [k, k+\\x00) aliases the same
         blob bytes (begin = (off, len), end = (off, len+1)) — no
-        per-range bytes concatenation, which dominated the profile."""
+        per-range bytes concatenation, which dominated the profile. The
+        commit proxy feeds this branch for the native backend:
+        Resolver.wants_point_split routes single-key conflict ranges
+        into the txn's point lanes (ADVICE r5: the branch was
+        unreachable while only the tpu backend asked for the split)."""
         blob = bytearray()
         blob_extend, blob_append = blob.extend, blob.append
         reads, writes = [], []

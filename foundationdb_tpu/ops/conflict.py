@@ -242,7 +242,11 @@ def resolve_batch(
         shard_idx = jnp.int32(0)
         mesh_n = 1
         for nm in names:
-            sz = jax.lax.axis_size(nm)
+            # lax.axis_size is the modern API; older jax answers the
+            # static size via the psum(1, axis) idiom
+            sz = (jax.lax.axis_size(nm)
+                  if hasattr(jax.lax, "axis_size")
+                  else jax.lax.psum(1, nm))
             shard_idx = shard_idx * sz + jax.lax.axis_index(nm)
             mesh_n *= sz
         if n_shards != mesh_n:

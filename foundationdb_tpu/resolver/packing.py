@@ -49,10 +49,26 @@ class BatchPacker:
         self.params = params
         self.codec = KeyCodec(num_limbs=params.key_width - 1)
         self._native = None
+        self._empty = None  # cached zero-txn pad batch (pack_empty)
         if use_native and params.key_width - 1 <= 16:
             from foundationdb_tpu.native import load_packer
 
             self._native = load_packer()
+
+    def pack_empty(self, base_version, commit_version, new_window_start):
+        """A zero-txn pad batch (resolve_many's fixed-width padding).
+        The zero arrays are immutable and version-independent, so ONE
+        cached template serves every dispatch — only the cv/window
+        scalars are swapped. Re-packing pads each backlog dispatch was
+        measurable in the commit pipeline's pack stage."""
+        if self._empty is None:
+            self._empty = self.pack([], 0, 0, 0)
+        return self._empty._replace(
+            cv=np.uint32(commit_version - base_version),
+            new_window_start=np.uint32(
+                max(0, new_window_start - base_version)
+            ),
+        )
 
     def _normalize(self, txn):
         """Fold a txn whose op lists exceed the packed lanes: overflow

@@ -32,14 +32,15 @@ BACKLOG_B = 8
 # failed to build (Mosaic lowering) or to run (XLA runtime fault) on
 # this backend. Anything else — packer bugs, shape errors from our own
 # code — must propagate, NOT silently wipe the device conflict history.
-_PALLAS_FALLBACK_ERRORS = [jax.errors.JaxRuntimeError, NotImplementedError]
-try:  # Mosaic's TPU lowering failures have their own exception type
-    from jax._src.pallas.mosaic.lowering import LoweringException
-
-    _PALLAS_FALLBACK_ERRORS.append(LoweringException)
-except ImportError:  # pragma: no cover — older/newer jax layouts
-    pass
-_PALLAS_FALLBACK_ERRORS = tuple(_PALLAS_FALLBACK_ERRORS)
+# Mosaic's LoweringException is deliberately NOT imported here: an
+# eager `from jax._src.pallas.mosaic.lowering import ...` at module
+# import time partially initializes jax._src.pallas.pallas_call —
+# registering its config flags, then dying on the circular init — after
+# which ANY later `import jax.experimental.pallas` in the process fails
+# with "Config option already defined". The module-origin check below
+# classifies LoweringException (module starts with "jax") without ever
+# naming the type.
+_PALLAS_FALLBACK_ERRORS = (jax.errors.JaxRuntimeError, NotImplementedError)
 
 
 def _is_pallas_fallback_error(e):
@@ -56,6 +57,32 @@ def _is_pallas_fallback_error(e):
 class ResolverDown(Exception):
     """This resolver process is dead; the proxy fails the batch
     not_committed and the cluster controller recruits a replacement."""
+
+
+class ResolveHandle:
+    """Deferred-sync result of a ``resolve_many`` dispatch.
+
+    JAX dispatch is asynchronous: the scanned backlog kernel is enqueued
+    on the device the moment ``resolve_many`` returns, but the statuses
+    only need to exist on the host when the proxy's apply stage consumes
+    them. Holding the un-materialized device arrays here lets the commit
+    pipeline overlap device compute with the PREVIOUS group's tlog push
+    and storage apply; ``wait()`` performs the one host sync
+    (``np.asarray``) and unpacks per-batch status lists. Host backends
+    (and fallback paths) resolve eagerly at dispatch — their handle just
+    hands the finished result back."""
+
+    __slots__ = ("_materialize", "_result")
+
+    def __init__(self, materialize=None, result=None):
+        self._materialize = materialize
+        self._result = result
+
+    def wait(self):
+        if self._materialize is not None:
+            self._result = self._materialize()
+            self._materialize = None
+        return self._result
 
 
 def params_from_knobs(knobs, use_pallas=False):
@@ -95,11 +122,13 @@ class Resolver:
         self.backend = knobs.resolver_backend
         self.base_version = base_version
         self.alive = True
-        # only the device kernel has dedicated point LANES; the host
-        # backends treat a point as the tiny range it is, so the proxy
-        # skips the per-range point/range split for them (it was the
-        # hottest line of the host commit pipeline)
-        self.wants_point_split = self.backend == "tpu"
+        # The device kernel has dedicated point LANES, and the native
+        # conflict set packs a split-out point key once with its end
+        # span aliasing the same blob bytes — both want the proxy's
+        # point/range split. The pure-python cpu backend treats a point
+        # as the tiny range it is, so the proxy skips the split there
+        # (it was the hottest line of the host commit pipeline).
+        self.wants_point_split = self.backend in ("tpu", "native")
         if self.backend == "tpu":
             pallas = getattr(knobs, "pallas_ring", "auto")
             use_pallas = pallas == "on" or (
@@ -247,7 +276,7 @@ class Resolver:
                 point_only = False  # needs range lanes this batch
         return point_only and not self._range_history
 
-    def resolve_many(self, batches):
+    def resolve_many(self, batches, lazy=False):
         """Resolve a BACKLOG of batches in one device dispatch.
 
         ``batches``: list of (txns, commit_version, new_window_start) in
@@ -258,19 +287,38 @@ class Resolver:
         when the chip is behind a high-latency tunnel. The batch count
         is padded to a small power of two (empty batches commit nothing)
         so distinct backlog sizes share compilations.
+
+        ``lazy=True`` returns a :class:`ResolveHandle` instead of the
+        status lists: the device work is dispatched (history state is
+        threaded at dispatch time, so a later dispatch still sees this
+        one's writes) but the host sync is deferred to ``wait()`` — the
+        commit pipeline's stage C. Dispatch-time failures (dead
+        resolver, packer errors) still raise here; only the
+        materialization moves.
         """
+        handle = self._dispatch_many(batches)
+        return handle if lazy else handle.wait()
+
+    def _dispatch_many(self, batches):
         if (self.backend != "tpu" or len(batches) <= 1
                 or any(len(t) > self.params.txns for t, _, _ in batches)):
-            return [self.resolve(t, cv, ws) for t, cv, ws in batches]
+            # host backends / degenerate backlogs resolve eagerly — the
+            # handle is already settled
+            return ResolveHandle(
+                result=[self.resolve(t, cv, ws) for t, cv, ws in batches]
+            )
         if len(batches) > BACKLOG_B:
             # Oversized backlog — the overload case this path exists for.
             # Chunk into BACKLOG_B-wide scans (each one dispatch) instead
             # of collapsing to per-batch round trips: throughput stays
             # scan-bound, not RTT-bound, no matter how deep the queue.
-            out = []
-            for i in range(0, len(batches), BACKLOG_B):
-                out.extend(self.resolve_many(batches[i:i + BACKLOG_B]))
-            return out
+            handles = [
+                self._dispatch_many(batches[i:i + BACKLOG_B])
+                for i in range(0, len(batches), BACKLOG_B)
+            ]
+            return ResolveHandle(materialize=lambda: [
+                statuses for h in handles for statuses in h.wait()
+            ])
         if not self.alive:
             raise ResolverDown()
         self._maybe_rebase(batches[-1][1])
@@ -295,13 +343,13 @@ class Resolver:
         # Pad to ONE fixed bucket: a scan compile costs tens of seconds
         # on a tunneled chip, so every backlog size must share the same
         # compilation (empty padding batches cost ~ms of device time —
-        # noise against the round trip this dispatch saves).
+        # noise against the round trip this dispatch saves; pads come
+        # from the packer's cached template, not a fresh pack).
         B = BACKLOG_B
         last_cv, last_ws = batches[-1][1], batches[-1][2]
-        while len(packed) < B:
-            packed.append(
-                packer.pack([], self.base_version, last_cv, last_ws)
-            )
+        if len(packed) < B:
+            pad = packer.pack_empty(self.base_version, last_cv, last_ws)
+            packed.extend([pad] * (B - len(packed)))
         key = (use_fast, B)
         scan_fn = self._scan_fns.get(key)
         if scan_fn is None:
@@ -309,14 +357,18 @@ class Resolver:
             self._scan_fns[key] = scan_fn
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *packed)
         self.state, st = scan_fn(self.state, stacked)
-        st = np.asarray(st)
-        out = []
-        for b, (statuses, live, cv, ws) in enumerate(per_batch):
-            row = st[b][: len(live)].tolist()
-            for (i, _), s in zip(live, row):
-                statuses[i] = s
-            out.append(statuses)
-        return out
+
+        def materialize():
+            arr = np.asarray(st)  # the ONE host sync for the backlog
+            out = []
+            for b, (statuses, live, cv, ws) in enumerate(per_batch):
+                row = arr[b][: len(live)].tolist()
+                for (i, _), s in zip(live, row):
+                    statuses[i] = s
+                out.append(statuses)
+            return out
+
+        return ResolveHandle(materialize=materialize)
 
     def _maybe_rebase(self, commit_version):
         """Keep uint32 version offsets in range (core/versions.py).

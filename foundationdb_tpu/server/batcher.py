@@ -11,12 +11,18 @@ Two drive modes:
 
 - **thread** (live deployments, the e2e bench): a daemon batcher thread
   collects submissions for up to ``interval_s`` (or until ``max_batch``),
-  then runs the inner proxy's commit_batch once. Clients block on a
-  CommitFuture. The inner pipeline (resolve → tlog → storage apply) runs
-  only on the batcher thread; client threads read storage under each
-  StorageServer's mutation lock (storage.py ``_mu``), which the apply/
-  flush path also takes — point and range reads are consistent even
-  while the batcher mutates the overlay.
+  then drives the inner proxy. Clients block on a CommitFuture. With
+  ``knobs.commit_pipeline_depth > 1`` the drain loop is a bounded
+  TWO-STAGE pipeline: the batcher thread runs stage A+B of each backlog
+  group (version grant + host packing + gate-ordered lazy resolve
+  dispatch, proxy.commit_batches_begin) and a second apply worker runs
+  stage C (status sync + tlog push + storage apply,
+  proxy.commit_batches_finish) strictly in grant order — so group N+1
+  packs on the host and resolves on the device while group N applies.
+  Depth 1 reproduces the old serial loop exactly. Client threads read
+  storage under each StorageServer's mutation lock (storage.py
+  ``_mu``), which the apply/flush path also takes — point and range
+  reads are consistent even while the pipeline mutates the overlay.
 
 - **manual** (deterministic simulation): no thread, no wall clock.
   Actors submit and yield on the future; the sim scheduler calls
@@ -28,8 +34,10 @@ Two drive modes:
 
 import threading
 import time
+from collections import deque
 
 from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.utils.trace import StageStats
 
 
 _UNSET = object()
@@ -100,6 +108,29 @@ class BatchingCommitProxy:
         self.last_batch_error = None
         self._backlog_target = self.MAX_BACKLOG
         self._thread = None
+        # ── bounded commit pipeline (thread mode only) ──
+        # Up to ``commit_pipeline_depth`` backlog groups in flight:
+        # this thread runs stage A+B (version grant + host packing +
+        # lazy resolve dispatch) for group N+1 while the apply worker
+        # runs stage C (status sync + tlog push + storage apply) for
+        # group N. Depth 1 — and manual/sim mode always — is the
+        # strictly serial drain loop, byte-for-byte today's behavior.
+        depth = getattr(knobs, "commit_pipeline_depth", 1)
+        self.pipeline_depth = max(1, int(depth)) if mode == "thread" else 1
+        self.stages = StageStats()
+        self._inflight = deque()  # [(chunks, _PipelinedGroup)] FIFO
+        self._inflight_cv = threading.Condition()
+        self._occ_level = 0
+        self._occ_t = time.perf_counter()
+        self._occ_busy = 0.0  # seconds with >=1 group in flight
+        self._occ_area = 0.0  # integral of in-flight count over busy time
+        self._apply_thread = None
+        if mode == "thread" and self.pipeline_depth > 1 \
+                and hasattr(inner, "commit_batches_begin"):
+            self._apply_thread = threading.Thread(
+                target=self._apply_loop, name="commit-apply", daemon=True
+            )
+            self._apply_thread.start()
         if mode == "thread":
             self._thread = threading.Thread(
                 target=self._batcher_loop, name="commit-batcher", daemon=True
@@ -132,12 +163,15 @@ class BatchingCommitProxy:
 
     # ─────────────────────────── batch driving ──────────────────────────
     def flush(self):
-        """Drain everything pending into one inner commit_batch."""
+        """Drain everything pending into one inner commit_batch, then
+        wait for any in-flight pipelined groups to settle — a returned
+        flush means every submitted commit has resolved."""
         with self._lock:
             pending, self._pending = self._pending, []
             self._first_pending_step = None
         if pending:
             self._run_batch(pending)
+        self.drain_pipeline()
 
     def pump(self, step):
         """Manual-mode heartbeat from the sim scheduler: flush when full
@@ -191,10 +225,32 @@ class BatchingCommitProxy:
             if len(group) > 1 and hasattr(self.inner, "commit_batches"):
                 # a backlog: one resolver dispatch covers every chunk
                 # (ref: the proxy pipelining resolution across batches)
+                reqs = [[r for r, _ in c] for c in group]
+                if self._apply_thread is not None:
+                    try:
+                        eligible = self.inner.pipeline_eligible(reqs)
+                    except Exception as e:
+                        self._fail_chunks(group, e)
+                        continue
+                    if eligible:
+                        # the pipelined route: stages A+B now, stage C
+                        # on the apply worker while the NEXT group
+                        # packs here
+                        try:
+                            self._pipeline_submit(group, reqs)
+                        except Exception as e:
+                            # begin died outside its own guards (e.g. a
+                            # dedupe/storage TOCTOU): same contract as a
+                            # failed commit_batches — futures resolve
+                            self._fail_chunks(group, e)
+                        continue
+                # serial fallback (lock/dedupe-hit/overload/fleet of
+                # resolvers): in-flight pipelined groups must settle
+                # first or this group's versions would overtake theirs
+                # at the log
+                self.drain_pipeline()
                 try:
-                    results_list = self.inner.commit_batches(
-                        [[r for r, _ in c] for c in group]
-                    )
+                    results_list = self.inner.commit_batches(reqs)
                 except Exception as e:
                     self._fail_chunks(group, e)
                     continue
@@ -208,6 +264,7 @@ class BatchingCommitProxy:
                     )
                 self._adapt_backlog(txns, conflicts)
                 continue
+            self.drain_pipeline()
             for chunk in group:
                 try:
                     results = self.inner.commit_batch([r for r, _ in chunk])
@@ -226,6 +283,116 @@ class BatchingCommitProxy:
                     sum(1 for r in results
                         if isinstance(r, FDBError) and r.code == 1020),
                 )
+
+    # ─────────────────────── pipeline executor ──────────────────────
+    def _occ_transition(self, new_level):
+        """Time-weighted in-flight accounting (under _inflight_cv):
+        ``pipeline_depth_effective`` is the average number of groups in
+        flight while the pipeline was busy — 1.0 means the stages never
+        actually overlapped, ~depth means the pipe stayed full."""
+        now = time.perf_counter()
+        if self._occ_level > 0:
+            dt = now - self._occ_t
+            self._occ_busy += dt
+            self._occ_area += self._occ_level * dt
+        self._occ_t = now
+        self._occ_level = new_level
+
+    @property
+    def pipeline_depth_effective(self):
+        with self._inflight_cv:
+            if self._occ_busy <= 0:
+                return 1.0
+            return round(self._occ_area / self._occ_busy, 2)
+
+    def stage_summary(self):
+        """Per-stage mean wall time (ms) + occupancy for the bench
+        artifact: pack (grant + host packing + dispatch, stage A+B),
+        resolve (the host sync stall in stage C), apply (tlog push +
+        storage apply + settlement)."""
+        return {
+            "stage_pack_ms": round(self.stages.mean_ms("pack"), 3),
+            "stage_resolve_ms": round(self.stages.mean_ms("resolve"), 3),
+            "stage_apply_ms": round(self.stages.mean_ms("apply"), 3),
+            "pipeline_depth": self.pipeline_depth,
+            "pipeline_depth_effective": self.pipeline_depth_effective,
+        }
+
+    def _pipeline_submit(self, group_chunks, reqs):
+        """Run stages A+B for one backlog group and hand it to the
+        apply worker; blocks while ``pipeline_depth`` groups are already
+        in flight (bounding version-grant runahead and host memory)."""
+        with self._inflight_cv:
+            while len(self._inflight) >= self.pipeline_depth \
+                    and self._apply_thread.is_alive():
+                self._inflight_cv.wait(timeout=1.0)
+        t0 = time.perf_counter()
+        pgroup = self.inner.commit_batches_begin(reqs)
+        self.stages.add("pack", time.perf_counter() - t0)
+        with self._inflight_cv:
+            self._inflight.append((group_chunks, pgroup))
+            self._occ_transition(len(self._inflight))
+            self._inflight_cv.notify_all()
+
+    def drain_pipeline(self):
+        """Block until every in-flight group has settled (ordering
+        barrier before serial fallbacks, flush, and close)."""
+        if self._apply_thread is None:
+            return
+        with self._inflight_cv:
+            while self._inflight and self._apply_thread.is_alive():
+                self._inflight_cv.wait(timeout=1.0)
+
+    def _apply_loop(self):
+        while True:
+            with self._inflight_cv:
+                while not self._inflight and not self._closed:
+                    self._inflight_cv.wait()
+                if not self._inflight and self._closed:
+                    return
+                group_chunks, pgroup = self._inflight[0]
+            try:
+                self._finish_group(group_chunks, pgroup)
+            except BaseException as e:  # pragma: no cover — last resort
+                # _finish_group resolves futures itself; this guard only
+                # keeps the worker alive (a dead worker would hang both
+                # drain_pipeline and every waiting client). Futures are
+                # re-set defensively — set() on a settled future is a
+                # no-op-safe overwrite the waiters never observe twice.
+                self.last_batch_error = e
+                try:
+                    self._fail_chunks(group_chunks, e)
+                except Exception:
+                    pass
+            finally:
+                with self._inflight_cv:
+                    self._inflight.popleft()
+                    self._occ_transition(len(self._inflight))
+                    self._inflight_cv.notify_all()
+
+    def _finish_group(self, group_chunks, pgroup):
+        """Stage C for one group: finish at the proxy, settle futures
+        in order, feed the AIMD backlog and the stage timers."""
+        try:
+            results_list = self.inner.commit_batches_finish(pgroup)
+        except Exception as e:
+            self._fail_chunks(group_chunks, e)
+            return
+        if pgroup.error is not None:
+            # the group failed inside the proxy (results are honest
+            # 1020/1021s); record the root cause like the serial path
+            self.last_batch_error = pgroup.error
+        self.stages.add("resolve", pgroup.resolve_s)
+        self.stages.add("apply", pgroup.apply_s)
+        txns = conflicts = 0
+        for chunk, results in zip(group_chunks, results_list):
+            self._settle(chunk, results)
+            txns += len(results)
+            conflicts += sum(
+                1 for r in results
+                if isinstance(r, FDBError) and r.code == 1020
+            )
+        self._adapt_backlog(txns, conflicts)
 
     def _settle(self, chunk, results):
         self.batches_committed += 1
@@ -290,6 +457,12 @@ class BatchingCommitProxy:
                 # would interleave two commit_batch runs on shared state
                 return
         self.flush()
+        if self._apply_thread is not None:
+            # flush drained the pipe; the closed flag lets the worker
+            # exit its wait loop
+            with self._inflight_cv:
+                self._inflight_cv.notify_all()
+            self._apply_thread.join(timeout=30)
         if hasattr(self.inner, "close"):
             self.inner.close()  # release the sub-resolve pool
 

@@ -146,6 +146,20 @@ class ProxyFleet:
             default=1,
         )
 
+    def stage_summary(self):
+        """Fleet view of the members' commit-pipeline stage timings:
+        means across members, worst-case configured depth."""
+        sums = [m.stage_summary() for m in self.members
+                if hasattr(m, "stage_summary")]
+        if not sums:
+            return {}
+        out = {}
+        for k in sums[0]:
+            vals = [s[k] for s in sums]
+            out[k] = (max(vals) if k == "pipeline_depth"
+                      else round(sum(vals) / len(vals), 3))
+        return out
+
     def __len__(self):
         return len(self.inners)
 

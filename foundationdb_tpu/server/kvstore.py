@@ -19,7 +19,10 @@ import sqlite3
 import struct
 import zlib
 
-from sortedcontainers import SortedDict
+try:
+    from sortedcontainers import SortedDict
+except ImportError:  # container without the dep: the in-repo shim
+    from foundationdb_tpu.utils.sorteddict import SortedDict
 
 _META_VERSION_KEY = b"\xff\xff/kvstore_version"
 
@@ -551,12 +554,16 @@ class KeyValueStoreVersionedDisk:
 
     def iter_chains(self, begin, end):
         """Full (key, version-chain) pairs in [begin, end) — shard export
-        carries engine-held history (same contract as the RAM engine)."""
+        carries engine-held history (same contract as the RAM engine).
+        ``end=None`` (the last shard's open upper bound) omits the end
+        clause — ``k < NULL`` matches nothing in SQL."""
         chain_key, chain = None, []
-        cur = self._conn.execute(
-            "SELECT k, v, val FROM kvv WHERE k >= ? AND k < ?"
-            " ORDER BY k, v", (begin, end),
-        )
+        q = "SELECT k, v, val FROM kvv WHERE k >= ?"
+        args = [begin]
+        if end is not None:
+            q += " AND k < ?"
+            args.append(end)
+        cur = self._conn.execute(q + " ORDER BY k, v", args)
         for k, v, val in cur:
             k = bytes(k)
             if k != chain_key:
@@ -609,20 +616,30 @@ class KeyValueStoreVersionedDisk:
     def clear_range(self, begin, end):
         # tombstone every key LIVE at the durable version (a clear is a
         # versioned write, not physical deletion — history stays
-        # readable below it)
-        rows = self._conn.execute(
-            "SELECT k, val, MAX(v) FROM kvv WHERE k >= ? AND k < ?"
-            " AND v <= ? GROUP BY k", (begin, end, self._version),
-        ).fetchall()
+        # readable below it); end=None = open-ended, like iter_range_at
+        q = "SELECT k, val, MAX(v) FROM kvv WHERE k >= ?"
+        args = [begin]
+        if end is not None:
+            q += " AND k < ?"
+            args.append(end)
+        args.append(self._version)
+        rows = self._conn.execute(q + " AND v <= ? GROUP BY k",
+                                  args).fetchall()
         for k, val, _ in rows:
             if val is not None:
                 self.set_versioned(bytes(k), self._version, None)
 
     def erase_range(self, begin, end):
         """Physically delete all chains in [begin, end) — history and
-        all (shard ingest evicting a stale pre-move copy; NOT a clear)."""
-        self._conn.execute(
-            "DELETE FROM kvv WHERE k >= ? AND k < ?", (begin, end))
+        all (shard ingest evicting a stale pre-move copy; NOT a clear).
+        ``end=None`` erases the open-ended tail, matching the RAM
+        engine's irange semantics."""
+        q = "DELETE FROM kvv WHERE k >= ?"
+        args = [begin]
+        if end is not None:
+            q += " AND k < ?"
+            args.append(end)
+        self._conn.execute(q, args)
 
     def prune(self, before_version):
         """Drop history below the horizon: each chain keeps its newest

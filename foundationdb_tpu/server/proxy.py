@@ -62,6 +62,30 @@ class VersionGate:
             self._cond.notify_all()
 
 
+class _PipelinedGroup:
+    """One backlog group mid-pipeline: versions granted, txns packed,
+    resolve dispatched lazily (stage A+B done). ``commit_batches_finish``
+    completes stage C. A group that failed in begin carries its
+    precomputed ``results_list`` plus whether its grant's gate turns are
+    still owed; ``resolve_s``/``apply_s`` are stage-C timings the
+    batcher folds into its StageStats."""
+
+    __slots__ = ("request_batches", "metas", "handle", "first_prev",
+                 "last_cv", "granted", "results_list", "error",
+                 "resolve_s", "apply_s")
+
+    def __init__(self, request_batches):
+        self.request_batches = request_batches
+        self.metas = None
+        self.handle = None
+        self.first_prev = self.last_cv = None
+        self.granted = False
+        self.results_list = None
+        self.error = None
+        self.resolve_s = 0.0
+        self.apply_s = 0.0
+
+
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None,
@@ -239,12 +263,33 @@ class CommitProxy:
                     systemdata.unpack_version(row)
         return None
 
+    def _pin_idmp_rv(self, requests):
+        """Assign the lazy read version of read-free id-CARRYING
+        requests BEFORE their dedupe lookup runs. The lookup and the
+        OCC read conflict on the idmp row (_idmp_point) together cover
+        every interleaving with a concurrently-committing original only
+        if rv is fixed first: an original visible before the pin is
+        caught by the lookup (apply precedes report_committed, so the
+        row is readable at rv); one landing after has cv > rv and the
+        retry's idmp read range conflicts. Pinning here means these
+        requests skip the constrained-budget admission gate's lazy-rv
+        charge — acceptable: id-carrying blind writes are rare and the
+        alternative is a double-apply window."""
+        for reqs in requests:
+            for r in reqs:
+                if (r.read_version is None
+                        and getattr(r, "idempotency_id", None)):
+                    r.read_version = self.sequencer.committed_version
+
     def _dedupe_idempotent(self, requests):
         """Proxy-side exactly-once (ref: IdempotencyId — ours is checked
         AT the proxy, which closes the client-check's resubmit race:
         commits serialize through this pipeline, so by the time a retry
         runs, its original either applied — id row visible — or never
-        will). Returns merged results, or None when nothing matched."""
+        will; the OCC conflict ranges _idmp_point declares extend the
+        guarantee across fleet members and pipeline groups). Returns
+        merged results, or None when nothing matched."""
+        self._pin_idmp_rv([requests])
         results = [None] * len(requests)
         passing = []
         for i, r in enumerate(requests):
@@ -436,6 +481,7 @@ class CommitProxy:
         # Degrading the whole backlog on a match trades throughput for
         # simplicity exactly once per retry, not steady-state.
         rk = self.ratekeeper
+        self._pin_idmp_rv(request_batches)
         if any(getattr(r, "idempotency_id", None)
                and self._idmp_lookup(r.idempotency_id) is not None
                for reqs in request_batches for r in reqs) or (
@@ -513,6 +559,183 @@ class CommitProxy:
             if self.log_gate is not None:
                 self.log_gate.advance(last_cv)
 
+    @staticmethod
+    def _idmp_point(r):
+        """The idmp system row an id-carrying request writes (and must
+        read-conflict on), or None. Declaring both conflict ranges on
+        that row makes OCC serialize a retry against its own original
+        even when the two land on DIFFERENT fleet members (or different
+        pipeline groups) concurrently: whichever resolves second sees
+        the other's write over its read and gets 1020, retries, and the
+        dedupe then answers the original's version (ADVICE r5: a
+        read-free id-carrying retry could double-apply)."""
+        iid = getattr(r, "idempotency_id", None)
+        if not iid:
+            return None
+        from foundationdb_tpu.core import systemdata
+
+        return systemdata.idmp_key(iid)
+
+    # ── pipelined backlog (server/batcher.py's bounded pipeline) ─────
+    # The serial _commit_batches_locked split into stages so the batcher
+    # can keep commit_pipeline_depth groups in flight: stage A+B
+    # (commit_batches_begin — version grant, host packing, gate-ordered
+    # LAZY resolve dispatch) run on the batcher thread while stage C
+    # (commit_batches_finish — status sync, tlog push, storage apply)
+    # runs on the apply thread for the PREVIOUS group. Ordering
+    # invariants are exactly the fleet's: the resolve gate serializes
+    # dispatch in grant order (history is stateful), the log gate
+    # serializes the apply tail; intra-proxy the batcher's FIFO apply
+    # queue provides the same order when no fleet gates exist.
+
+    def pipeline_eligible(self, request_batches):
+        """Cheap stage-A admission check: the pipelined path serves the
+        common case only. Anything needing per-request partitioning or
+        per-batch serialization (database lock, tenant enforcement, a
+        constrained ratekeeper charging lazy-rv requests, a dedupe HIT,
+        multi-resolver host fan-out, dead roles) routes back to the
+        serial commit_batches, which already handles it."""
+        rk = self.ratekeeper
+        if (len(self.resolvers) != 1 or not self.alive
+                or not self.sequencer.alive
+                or getattr(self, "lock_uid", None) is not None
+                or getattr(self, "tenant_mode", "optional") != "optional"):
+            return False
+        if (rk is not None and rk.target_tps < rk.UNLIMITED_TPS
+                and any(r.read_version is None
+                        for reqs in request_batches for r in reqs)):
+            return False
+        self._pin_idmp_rv(request_batches)
+        return not any(
+            getattr(r, "idempotency_id", None)
+            and self._idmp_lookup(r.idempotency_id) is not None
+            for reqs in request_batches for r in reqs
+        )
+
+    def commit_batches_begin(self, request_batches):
+        """Stages A+B of the pipelined backlog: chained version grant,
+        host packing, and the gate-ordered lazy resolve dispatch.
+        Always returns a _PipelinedGroup — failures are captured in the
+        group (results precomputed, owed gate turns recorded) so the
+        caller settles them through commit_batches_finish IN ORDER with
+        the rest of the pipeline. Caller contract: begin runs on one
+        thread in grant order; finish runs FIFO on one thread."""
+        group = _PipelinedGroup(request_batches)
+        err_1021 = lambda: [
+            [FDBError.from_name("commit_unknown_result") for _ in reqs]
+            for reqs in request_batches
+        ]
+        try:
+            pairs = self.sequencer.next_commit_versions(len(request_batches))
+        except SequencerDown:
+            group.results_list = err_1021()
+            return group
+        group.first_prev, group.last_cv = pairs[0][0], pairs[-1][1]
+        group.granted = True
+        try:
+            metas = []
+            for reqs, (prev, cv) in zip(request_batches, pairs):
+                window = max(
+                    0, cv - self.knobs.max_read_transaction_life_versions
+                )
+                metas.append((reqs, self._build_txns(reqs), cv, window))
+        except BaseException as e:
+            group.error = e
+            group.results_list = err_1021()
+            return group
+        try:
+            if self.resolve_gate is not None:
+                self.resolve_gate.enter(group.first_prev)
+            try:
+                group.handle = self.resolvers[0].resolve_many(
+                    [(txns, cv, window) for _, txns, cv, window in metas],
+                    lazy=True,
+                )
+            finally:
+                if self.resolve_gate is not None:
+                    self.resolve_gate.advance(group.last_cv)
+        except GateTimeout:
+            # wedged fleet: kill + blanket 1021s; no turn consumption —
+            # only a txn-system recovery (fresh gates) unwedges
+            group.granted = False
+            group.results_list = [
+                self._gate_wedged(len(reqs)) for reqs in request_batches
+            ]
+            return group
+        except ResolverDown:
+            # definitively not committed; the log turn is still owed
+            group.results_list = [
+                [FDBError.from_name("not_committed") for _ in reqs]
+                for reqs in request_batches
+            ]
+            return group
+        except BaseException as e:
+            group.error = e
+            group.results_list = err_1021()
+            return group
+        group.metas = metas
+        return group
+
+    def commit_batches_finish(self, group):
+        """Stage C of the pipelined backlog: materialize the resolve
+        statuses (the one host↔device sync), then the gate-ordered tail
+        — tlog push, storage apply, feeds, reporting. Also the
+        settlement point for groups that failed in begin: their owed
+        gate turns are consumed HERE, in pipeline order, so successors
+        never wait on a turn no one will take."""
+        import time as _time
+
+        if group.results_list is not None:
+            if group.granted:
+                self._skip_turns_quiet(group.first_prev, group.last_cv)
+            return group.results_list
+        t0 = _time.perf_counter()
+        try:
+            statuses_list = group.handle.wait()
+        except BaseException as e:
+            # the dispatched kernel faulted at materialization: the
+            # device history for these versions is suspect, but both
+            # turns must still be consumed (the resolve gate's advance
+            # already ran; the skip's enter/advance there are no-ops)
+            self._skip_turns_quiet(group.first_prev, group.last_cv)
+            group.error = e
+            return [
+                [FDBError.from_name("commit_unknown_result") for _ in reqs]
+                for reqs in group.request_batches
+            ]
+        group.resolve_s = _time.perf_counter() - t0
+        t1 = _time.perf_counter()
+        with self._commit_mu:
+            if not self.alive or not self.sequencer.alive:
+                # killed mid-pipeline (txn-system recovery quiesce):
+                # nothing may reach the log after the frontier read —
+                # consume the owed turns and answer honest 1021s
+                self._skip_turns_quiet(group.first_prev, group.last_cv)
+                return [
+                    [FDBError.from_name("commit_unknown_result")
+                     for _ in reqs]
+                    for reqs in group.request_batches
+                ]
+            try:
+                if self.log_gate is not None:
+                    self.log_gate.enter(group.first_prev)
+            except GateTimeout:
+                return [
+                    self._gate_wedged(len(reqs))
+                    for reqs in group.request_batches
+                ]
+            try:
+                return [
+                    self._finalize_batch(reqs, txns, statuses, cv, window,
+                                         prev=None)
+                    for (reqs, txns, cv, window), statuses
+                    in zip(group.metas, statuses_list)
+                ]
+            finally:
+                if self.log_gate is not None:
+                    self.log_gate.advance(group.last_cv)
+                group.apply_s = _time.perf_counter() - t1
+
     def _build_txns(self, requests):
         rv_assigned = None
         n_lazy = 0
@@ -536,20 +759,28 @@ class CommitProxy:
             # host backends: a point IS its tiny range — hand the
             # client's ranges through untouched (both byte strings
             # already exist; the split bought nothing but CPU)
-            return [
-                TxnRequest(
+            out = []
+            for r in requests:
+                ik = self._idmp_point(r)
+                extra = [(ik, ik + b"\x00")] if ik is not None else []
+                out.append(TxnRequest(
                     read_version=r.read_version,
                     point_reads=(), point_writes=(),
-                    range_reads=r.read_conflict_ranges,
-                    range_writes=r.write_conflict_ranges,
-                )
-                for r in requests
-            ]
+                    range_reads=list(r.read_conflict_ranges) + extra
+                    if extra else r.read_conflict_ranges,
+                    range_writes=list(r.write_conflict_ranges) + extra
+                    if extra else r.write_conflict_ranges,
+                ))
+            return out
         split = _split_ranges
         out = []
         for r in requests:
             pr, rr = split(r.read_conflict_ranges)
             pw, rw = split(r.write_conflict_ranges)
+            ik = self._idmp_point(r)
+            if ik is not None:
+                pr = pr + [ik]
+                pw = pw + [ik]
             out.append(TxnRequest(
                 read_version=r.read_version,
                 point_reads=pr, point_writes=pw,

@@ -15,7 +15,10 @@ tlog.
 import threading
 from collections import deque
 
-from sortedcontainers import SortedDict
+try:
+    from sortedcontainers import SortedDict
+except ImportError:  # container without the dep: the in-repo shim
+    from foundationdb_tpu.utils.sorteddict import SortedDict
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.keys import KeySelector, key_successor

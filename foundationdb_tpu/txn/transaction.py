@@ -521,8 +521,16 @@ class Transaction:
         # enforced at that gate (skipping it would let a throttled tag
         # write unthrottled); the untagged global budget is enforced at
         # the proxy for rv-None requests instead.
+        idmp = self._ensure_idempotency_id()
         if (self._read_version is None and not self._read_conflicts
-                and not self._tags):
+                and not self._tags and idmp is None):
+            # id-carrying txns never ride the lazy-rv fast path: the
+            # OCC serialization of a 1021 retry against its own
+            # original (the idmp-row conflict ranges the proxy declares
+            # in _build_txns) needs an honest read version — a
+            # proxy-assigned rv on a different fleet member could land
+            # at-or-after the original's commit and miss the conflict
+            # (ADVICE r5: the read-free retry double-apply race)
             rv = None
         else:
             rv = self.get_read_version()
@@ -533,7 +541,7 @@ class Transaction:
             write_conflict_ranges=_coalesce(self._write_conflicts),
             report_conflicting_keys=self._report_conflicting_keys,
             lock_aware=self._lock_aware,
-            idempotency_id=self._ensure_idempotency_id(),
+            idempotency_id=idmp,
         )
 
     def _ensure_idempotency_id(self):

@@ -1,0 +1,81 @@
+"""Minimal SortedDict stand-in for containers without sortedcontainers.
+
+The storage overlay and the kvstore engines need exactly one thing
+beyond ``dict``: ordered key iteration over a half-open range
+(``irange``). This shim keeps a lazily rebuilt sorted-key cache —
+invalidated whenever the key SET changes, untouched by value updates —
+and answers ``irange`` with bisect over it. Iteration returns a slice
+copy, which is strictly safer than sortedcontainers' live view under
+the "list() before mutating" discipline the call sites already follow.
+
+Complexity trades away from the real library (O(n log n) re-sort after
+an insert/delete burst instead of O(log n) per op), which is fine for
+the in-process cluster sizes tests and sims run at; deployments with
+sortedcontainers installed never load this module (see the gated
+imports in server/storage.py and server/kvstore.py).
+"""
+
+from bisect import bisect_left, bisect_right
+
+
+class SortedDict(dict):
+    __slots__ = ("_sorted",)
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._sorted = None
+
+    # ── mutations that can change the key set invalidate the cache ──
+    def __setitem__(self, key, value):
+        if self._sorted is not None and key not in self:
+            self._sorted = None
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        super().__delitem__(key)
+        self._sorted = None
+
+    def pop(self, *args):
+        self._sorted = None
+        return super().pop(*args)
+
+    def popitem(self):
+        self._sorted = None
+        return super().popitem()
+
+    def clear(self):
+        self._sorted = None
+        super().clear()
+
+    def update(self, *args, **kwargs):
+        self._sorted = None
+        super().update(*args, **kwargs)
+
+    def setdefault(self, key, default=None):
+        if self._sorted is not None and key not in self:
+            self._sorted = None
+        return super().setdefault(key, default)
+
+    # ── the ordered view ──
+    def _keys_sorted(self):
+        if self._sorted is None:
+            self._sorted = sorted(super().keys())
+        return self._sorted
+
+    def irange(self, minimum=None, maximum=None, inclusive=(True, True),
+               reverse=False):
+        ks = self._keys_sorted()
+        if minimum is None:
+            lo = 0
+        elif inclusive[0]:
+            lo = bisect_left(ks, minimum)
+        else:
+            lo = bisect_right(ks, minimum)
+        if maximum is None:
+            hi = len(ks)
+        elif inclusive[1]:
+            hi = bisect_right(ks, maximum)
+        else:
+            hi = bisect_left(ks, maximum)
+        span = ks[lo:hi]
+        return reversed(span) if reverse else iter(span)

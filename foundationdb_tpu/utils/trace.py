@@ -90,6 +90,38 @@ def global_trace_log():
     return _global
 
 
+class StageStats:
+    """Cumulative wall-time counters for a multi-stage pipeline (the
+    commit path's pack / resolve / apply stages). The batcher feeds it
+    from two threads — the producer times stage A+B, the apply worker
+    times stage C — so accumulation is lock-protected; reads take a
+    consistent snapshot. The bench surfaces ``summary()`` so per-stage
+    cost (and which stage is critical-path) lands in the artifact."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total_s = {}
+        self._count = {}
+
+    def add(self, stage, seconds):
+        with self._lock:
+            self._total_s[stage] = self._total_s.get(stage, 0.0) + seconds
+            self._count[stage] = self._count.get(stage, 0) + 1
+
+    def mean_ms(self, stage):
+        with self._lock:
+            n = self._count.get(stage, 0)
+            return (self._total_s.get(stage, 0.0) / n * 1e3) if n else 0.0
+
+    def summary(self):
+        """{stage: mean ms per observation} for every recorded stage."""
+        with self._lock:
+            return {
+                s: round(self._total_s[s] / self._count[s] * 1e3, 3)
+                for s in self._total_s if self._count.get(s)
+            }
+
+
 class TraceEvent:
     """Fluent structured event (ref: TraceEvent(\"Type\").detail(...).log()).
 

@@ -21,6 +21,9 @@ def test_compact_summary_is_small_and_headline_last():
         "pallas_kernel_step": True,
         "e2e_committed_txns_per_sec": 9400.0, "e2e_proxies": 2,
         "e2e_conflict_rate": 0.01,
+        # commit-pipeline stage timings (server/batcher.py StageStats)
+        "stage_pack_ms": 1.2, "stage_resolve_ms": 3.4,
+        "stage_apply_ms": 2.1, "pipeline_depth_effective": 1.8,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -37,6 +40,12 @@ def test_compact_summary_is_small_and_headline_last():
     # them inside the captured tail (insertion order is preserved)
     assert list(line.keys())[-3:] == ["metric", "value", "vs_baseline"]
     assert line["value"] == 1_675_000.0
+    # per-stage pipeline timings ride the summary so BENCH_* trajectories
+    # show which commit stage is critical-path
+    assert line["stage_pack_ms"] == 1.2
+    assert line["stage_resolve_ms"] == 3.4
+    assert line["stage_apply_ms"] == 2.1
+    assert line["pipeline_depth_effective"] == 1.8
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -79,6 +88,9 @@ def test_e2e_line_folds_proxies_and_platform():
     fields = bench.run_e2e(cpu=True, backend="cpu", seconds=0.5,
                            n_proxies=2)
     for key in ("e2e_proxies", "platform", "e2e_backend",
-                "e2e_conflict_rate", "e2e_backlog_target"):
+                "e2e_conflict_rate", "e2e_backlog_target",
+                "stage_pack_ms", "stage_resolve_ms", "stage_apply_ms",
+                "pipeline_depth", "pipeline_depth_effective"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
+    assert fields["pipeline_depth"] >= 1

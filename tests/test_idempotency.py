@@ -350,3 +350,74 @@ def test_sim_counter_exactly_once_under_unknown_results(tmp_path):
         total_1021 += stats["retried_1021"]
         sim.close()
     assert total_1021 > 0, "no commit_unknown_result was ever injected"
+
+
+def test_fleet_readfree_retry_cannot_double_apply():
+    """ADVICE r5 (medium): with n_commit_proxies>1, a READ-FREE
+    id-carrying retry could land on another fleet member whose dedupe
+    lookup ran before the original's apply — both committed, the blind
+    ADD applied twice. Closed by OCC: id-carrying requests declare
+    read+write conflict ranges on their idmp system row (_idmp_point),
+    and read-free ones have their rv pinned BEFORE the dedupe lookup
+    (_pin_idmp_rv), so the racing retry resolves 1020 instead."""
+    c = Cluster(n_commit_proxies=2, resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        A, B = c.commit_proxy.inners
+        one = (1).to_bytes(8, "little")
+        span = (b"ctr", b"ctr\x00")
+        # what B's rv pin would observe MID-RACE (before A's apply)
+        rv_pin = c.sequencer.committed_version
+        v1 = A.commit(CommitRequest(
+            read_version=None, mutations=[Mutation(Op.ADD, b"ctr", one)],
+            read_conflict_ranges=[], write_conflict_ranges=[span],
+            idempotency_id=b"race-tok",
+        ))
+        assert not isinstance(v1, FDBError)
+        # the retry as proxy B sees it inside the race window: dedupe
+        # lookup misses (original not applied when it ran), rv already
+        # pinned to the pre-original committed version
+        retry = CommitRequest(
+            read_version=rv_pin, mutations=[Mutation(Op.ADD, b"ctr", one)],
+            read_conflict_ranges=[], write_conflict_ranges=[span],
+            idempotency_id=b"race-tok",
+        )
+        orig_lookup = B._idmp_lookup
+        B._idmp_lookup = lambda iid: None  # the in-flight-original window
+        try:
+            res = B.commit(retry)
+        finally:
+            B._idmp_lookup = orig_lookup
+        assert isinstance(res, FDBError) and res.code == 1020, res
+        s = c.storage
+        assert int.from_bytes(s.get(b"ctr", s.version), "little") == 1
+        # outside the window the ordinary retry path answers the
+        # original's version (client resolves its 1021 to success)
+        res2 = B.commit(CommitRequest(
+            read_version=None, mutations=[Mutation(Op.ADD, b"ctr", one)],
+            read_conflict_ranges=[], write_conflict_ranges=[span],
+            idempotency_id=b"race-tok",
+        ))
+        assert res2 == v1
+        assert int.from_bytes(s.get(b"ctr", s.version), "little") == 1
+    finally:
+        c.close()
+
+
+def test_idmp_requests_never_ride_lazy_rv():
+    """Client side of the same fix: an id-carrying transaction always
+    takes an honest GRV (the proxy-assigned lazy rv on another fleet
+    member could land at-or-after the original's commit and miss the
+    idmp-row conflict)."""
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        db = c.database()
+        tr = db.create_transaction()
+        tr.options.set_idempotency_id(b"tok-rv")
+        tr.set(b"k", b"v")  # write-only: WOULD be read-free without the id
+        req = tr._build_commit_request()
+        assert req.read_version is not None
+        tr2 = db.create_transaction()
+        tr2.set(b"k", b"v")
+        assert tr2._build_commit_request().read_version is None
+    finally:
+        c.close()

@@ -399,6 +399,52 @@ def test_storage_versioned_export_ingest_preserves_history(versioned_factory):
     assert dst.get(b"m", 30) == b"3"
 
 
+def test_versioned_open_ended_ranges(versioned_factory):
+    """ADVICE r5 (high): the disk engine compared ``k < NULL`` for
+    end=None, so iter_chains/erase_range/clear_range silently no-oped on
+    the LAST shard's open upper bound. Both Redwood-role engines must
+    treat end=None as +infinity, like iter_range_at does."""
+    eng = versioned_factory("open")
+    eng.set_versioned(b"a", 10, b"1")
+    eng.set_versioned(b"m", 10, b"1")
+    eng.set_versioned(b"m", 20, b"2")
+    eng.set_versioned(b"z", 20, b"z")
+    eng.commit(20)
+    chains = dict(eng.iter_chains(b"m", None))
+    assert chains == {b"m": [(10, b"1"), (20, b"2")],
+                      b"z": [(20, b"z")]}
+    eng.clear_range(b"z", None)  # tombstone the open-ended tail
+    assert eng.get_at(b"z", 20) is None
+    eng.erase_range(b"m", None)  # physical eviction of the tail
+    assert dict(eng.iter_chains(b"m", None)) == {}
+    assert eng.get_at(b"a", 20) == b"1"  # keys below begin untouched
+
+
+def test_versioned_last_shard_move_open_ended(versioned_factory):
+    """Moving the open-ended LAST shard (end=None, as ShardMap's final
+    range reports it) between versioned storages: the export must carry
+    the engine-held history and the ingest must evict the joiner's stale
+    pre-move copy — on both engines (the disk engine silently moved
+    nothing before the open-ended range fix)."""
+    src = StorageServer(engine=versioned_factory("src"))
+    src.apply(10, [_set(b"t/a", b"1")])
+    src.apply(20, [_set(b"t/a", b"2")])
+    src.flush()  # history now lives in the ENGINE
+    src.apply(30, [_set(b"t/b", b"3")])  # plus overlay
+    dst = StorageServer(engine=versioned_factory("dst"))
+    # stale pre-move copy on the joiner that the ingest must evict
+    dst.apply(5, [_set(b"t/a", b"STALE")])
+    dst.flush()
+    for v in (10, 20, 30):
+        dst.apply(v, [])
+    dst.ingest_shard(b"t", None, src.export_shard(b"t", None))
+    assert dst.get(b"t/a", 15) == b"1"  # engine-held history moved
+    assert dst.get(b"t/a", 30) == b"2"
+    assert dst.get(b"t/b", 30) == b"3"
+    dst.flush()  # fold the ingested chains into the engine
+    assert dst.engine.get_at(b"t/a", 30) == b"2"  # stale copy evicted
+
+
 def test_cluster_versioned_engine_end_to_end(versioned_factory, tmp_path):
     """Cluster on the versioned engine: commits, aggressive durability,
     reads at old versions, crash/restart recovery."""

@@ -128,3 +128,34 @@ def test_cluster_native_backend_end_to_end():
     with pytest.raises(FDBError) as ei:
         t2.commit()
     assert ei.value.code == 1020
+
+
+def test_native_backend_receives_point_split():
+    """ADVICE r5 (low): NativeConflictSet.resolve's aliased point-packing
+    branch was dead code — only the tpu backend asked the proxy for the
+    point/range split. The native backend now opts in
+    (Resolver.wants_point_split), so single-key conflict ranges arrive
+    in the txns' point lanes and the allocation-lean branch runs."""
+    from foundationdb_tpu.server.cluster import Cluster
+    from tests.conftest import TEST_KNOBS
+
+    c = Cluster(resolver_backend="native", **TEST_KNOBS)
+    try:
+        assert c.resolvers[0].wants_point_split
+        seen = []
+        cset = c.resolvers[0].cset
+        orig = cset.resolve
+
+        def spy(txns, commit_version, new_window_start=None):
+            seen.extend(txns)
+            return orig(txns, commit_version, new_window_start)
+
+        cset.resolve = spy
+        db = c.database()
+        db.run(lambda tr: (tr.get(b"p"), tr.set(b"p", b"v"))[-1])
+        pr = sum(len(t.point_reads) for t in seen)
+        pw = sum(len(t.point_writes) for t in seen)
+        assert pr > 0 and pw > 0, (pr, pw)
+        assert db[b"p"] == b"v"
+    finally:
+        c.close()
