@@ -1,0 +1,13 @@
+"""flowlint — AST-based invariant checking for the package's own code.
+
+FoundationDB's reliability rests on two static pillars this Python port
+otherwise lacks: the actor compiler's compile-time enforcement of
+concurrency discipline and the simulator's guarantee that a seed
+replays byte-identically. ``flowlint`` recovers both as a lint pass
+over the package's AST (stdlib ``ast``, no dependencies): determinism
+seams (FL001), future settlement (FL002), lock discipline (FL003), jit
+purity (FL004), and exception hygiene (FL005).
+
+Run it: ``python -m foundationdb_tpu.analysis.flowlint`` (see
+``analysis/README.md`` for the rule catalog and baseline workflow).
+"""

@@ -1,0 +1,268 @@
+"""flowlint engine + CLI.
+
+Run over the package tree::
+
+    python -m foundationdb_tpu.analysis.flowlint            # whole package
+    python -m foundationdb_tpu.analysis.flowlint path/ file.py
+    python -m foundationdb_tpu.analysis.flowlint --fix-baseline
+
+Exit code 0 = no findings beyond the checked-in baseline
+(``analysis/baseline.txt``); 1 = new findings (printed). The baseline
+grandfathers pre-existing findings per (rule, file, message) — line
+numbers are deliberately NOT part of the key, so edits above a
+grandfathered site do not churn the file. ``--fix-baseline`` rewrites
+it from the current tree; a finding FIXED in code makes its stale entry
+disappear on the next ``--fix-baseline`` (the tree test warns about
+stale entries so debt reduction gets recorded).
+
+Per-line suppression: a ``# flowlint: disable=FL003`` comment on the
+finding's line (or the line above) suppresses that rule there — for
+sites where the pattern is deliberate and the reason is stated inline.
+``# flowlint: disable-file=FL004`` anywhere in a file suppresses the
+rule for the whole file.
+"""
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from collections import Counter
+
+from foundationdb_tpu.analysis.base import Finding
+from foundationdb_tpu.analysis.rules import ALL_RULES, BY_ID
+
+PKG_NAME = "foundationdb_tpu"
+
+_SUPPRESS_RE = re.compile(r"#\s*flowlint:\s*disable=([A-Z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*flowlint:\s*disable-file=([A-Z0-9,\s]+)"
+)
+
+
+def package_dir():
+    import foundationdb_tpu
+
+    return os.path.dirname(os.path.abspath(foundationdb_tpu.__file__))
+
+
+def default_baseline_path():
+    return os.path.join(package_dir(), "analysis", "baseline.txt")
+
+
+def module_relpath(path, root):
+    """Path keyed relative to the foundationdb_tpu package dir when the
+    file lives inside it ("server/batcher.py"), else relative to the
+    scan root — baselines stay valid no matter where the CLI runs."""
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if PKG_NAME in parts:
+        i = len(parts) - 1 - parts[::-1].index(PKG_NAME)
+        if i < len(parts) - 1:
+            return "/".join(parts[i + 1:])
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def _parse_rule_list(text):
+    return {r.strip() for r in text.replace(",", " ").split() if r.strip()}
+
+
+def lint_source(relpath, text, rules=None):
+    """All non-suppressed findings for one file's source text."""
+    rules = ALL_RULES if rules is None else rules
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding("FL000", relpath, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    file_disabled = set()
+    line_disabled = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_disabled |= _parse_rule_list(m.group(1))
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            line_disabled[i] = _parse_rule_list(m.group(1))
+    findings = []
+    for rule in rules:
+        if rule.RULE in file_disabled or not rule.applies(relpath):
+            continue
+        for f in rule.check(tree, relpath):
+            if f.rule in line_disabled.get(f.line, ()) or \
+                    f.rule in line_disabled.get(f.line - 1, ()):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith((".", "__pycache__"))
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, rules=None):
+    findings = []
+    for path in iter_py_files(paths):
+        root = paths[0] if os.path.isdir(paths[0]) else \
+            os.path.dirname(paths[0]) or "."
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        findings.extend(
+            lint_source(module_relpath(path, root), text, rules)
+        )
+    return findings
+
+
+# ───────────────────────────── baseline ─────────────────────────────
+def baseline_key(finding):
+    return f"{finding.rule}\t{finding.path}\t{finding.message}"
+
+
+def load_baseline(path):
+    """Multiset of grandfathered finding keys (missing file = empty)."""
+    counts = Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            counts[line] += 1
+    return counts
+
+
+def format_baseline(findings):
+    header = (
+        "# flowlint baseline — grandfathered findings, one per line:\n"
+        "#   RULE<TAB>path<TAB>message\n"
+        "# Regenerate: python -m foundationdb_tpu.analysis.flowlint "
+        "--fix-baseline\n"
+        "# Policy: FL001/FL002/FL003/FL005 must stay EMPTY here (fix "
+        "or suppress inline with a reason); FL004 entries are lint "
+        "debt to burn down.\n"
+    )
+    body = "".join(
+        key + "\n" for key in sorted(baseline_key(f) for f in findings)
+    )
+    return header + body
+
+
+def split_by_baseline(findings, baseline):
+    """(new, grandfathered, stale_keys): findings beyond the baseline's
+    per-key multiplicity are new; baseline keys the tree no longer
+    produces are stale (fixed — regenerate to record the progress)."""
+    used = Counter()
+    new, old = [], []
+    for f in findings:
+        key = baseline_key(f)
+        if used[key] < baseline.get(key, 0):
+            used[key] += 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [
+        key for key, n in baseline.items() if used.get(key, 0) < n
+        for _ in range(n - used.get(key, 0))
+    ]
+    return new, old, stale
+
+
+def count_findings(paths=None):
+    """Total findings (suppressions honored, baseline IGNORED) over the
+    package — the bench's ``flowlint_findings`` lint-debt gauge."""
+    findings = lint_paths(paths or [package_dir()])
+    return len(findings)
+
+
+# ─────────────────────────────── CLI ────────────────────────────────
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.analysis.flowlint",
+        description="AST invariant checker for foundationdb_tpu "
+                    "(FL001 determinism, FL002 future settlement, "
+                    "FL003 lock discipline, FL004 jit purity, "
+                    "FL005 exception hygiene).",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the installed "
+                         "foundationdb_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "foundationdb_tpu/analysis/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [package_dir()]
+    rules = None
+    if args.rules:
+        wanted = _parse_rule_list(args.rules)
+        unknown = wanted - set(BY_ID)
+        if unknown:
+            ap.error(f"unknown rule ids: {sorted(unknown)}")
+        rules = [BY_ID[r] for r in sorted(wanted)]
+    baseline_path = args.baseline or default_baseline_path()
+
+    findings = lint_paths(paths, rules)
+
+    if args.fix_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(format_baseline(findings))
+        print(f"baseline rewritten: {baseline_path} "
+              f"({len(findings)} entries)")
+        return 0
+
+    baseline = Counter() if args.no_baseline else \
+        load_baseline(baseline_path)
+    new, old, stale = split_by_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "new": [f._asdict() for f in new],
+            "baselined": len(old),
+            "stale_baseline": len(stale),
+            "total": len(findings),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        per_rule = Counter(f.rule for f in findings)
+        summary = ", ".join(
+            f"{r}={n}" for r, n in sorted(per_rule.items())
+        ) or "none"
+        print(f"flowlint: {len(new)} new finding(s), {len(old)} "
+              f"baselined, {len(stale)} stale baseline entr(ies); "
+              f"totals: {summary}")
+        if stale:
+            print("stale baseline entries (fixed in the tree — run "
+                  "--fix-baseline to record the progress):")
+            for key in stale:
+                print(f"  {key}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""flowlint rule registry — one module per rule id."""
+
+from foundationdb_tpu.analysis.rules import (
+    fl001_determinism,
+    fl002_settlement,
+    fl003_locks,
+    fl004_jit,
+    fl005_exceptions,
+)
+
+ALL_RULES = [
+    fl001_determinism,
+    fl002_settlement,
+    fl003_locks,
+    fl004_jit,
+    fl005_exceptions,
+]
+
+BY_ID = {rule.RULE: rule for rule in ALL_RULES}

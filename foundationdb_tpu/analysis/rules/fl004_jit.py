@@ -1,0 +1,194 @@
+"""FL004 — jit purity: traced code must stay traced.
+
+Ref rationale (accelerator side, not FDB): a function traced by
+``jax.jit`` / ``shard_map`` / ``pallas_call`` runs ONCE at trace time;
+host-side effects inside it (``np.*`` materialization, I/O,
+``TraceEvent``, mutating ``self``) either silently bake trace-time
+values into the compiled program or fire once instead of per step —
+the classic "it worked in eager mode" bug class. The resolver's
+donated-buffer history state makes this worse: a host round trip inside
+the traced step would break the no-copy contract the commit pipeline's
+overlap depends on.
+
+The rule (modules under ``ops/``, ``resolver/``, ``parallel/``): find
+jit roots — functions passed to ``jax.jit(...)`` / ``shard_map(...)``
+/ ``pallas_call(...)`` or decorated with them (including the
+``partial(jax.jit, ...)`` form) — and every module-local function
+reachable from a root through bare-name calls. In reachable functions,
+flag:
+
+- ``np.<attr>`` — host numpy inside traced code (use ``jnp``; host
+  packing belongs OUTSIDE the jitted step);
+- ``print(...)`` / ``open(...)`` — trace-time-only I/O (use
+  ``jax.debug.print`` if needed);
+- ``TraceEvent(...)`` — the observability spine is host-side;
+- assignments to ``self.<attr>`` — traced methods must not mutate
+  objects (the mutation happens at trace time only).
+
+This rule may carry a baseline: pre-existing findings are grandfathered
+in ``analysis/baseline.txt`` and burned down over time rather than
+suppressed inline.
+"""
+
+import ast
+
+from foundationdb_tpu.analysis.base import (
+    Finding,
+    dotted_name,
+    terminal_name,
+)
+
+RULE = "FL004"
+TITLE = "jit purity: no host effects in jit/shard_map-reachable code"
+
+SCOPES = ("ops/", "resolver/", "parallel/")
+TRACERS = {"jit", "shard_map", "pallas_call"}
+IO_CALLS = {"print", "open", "input"}
+
+
+def applies(relpath):
+    return relpath.startswith(SCOPES)
+
+
+def _callable_names(node):
+    """Function names statically extractable from an expression handed
+    to a tracer: a bare name, the functions a lambda body calls, or the
+    target inside a ``functools.partial(...)`` wrapper. Attribute
+    targets (``ck.resolve_batch``) contribute their terminal name —
+    module-local resolution decides whether it binds."""
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Lambda):
+        return [
+            name
+            for call in ast.walk(node.body) if isinstance(call, ast.Call)
+            for name in [terminal_name(call.func)] if name
+        ]
+    if isinstance(node, ast.Call) and terminal_name(node.func) in (
+        "partial", "scan_of"
+    ):
+        return [
+            name for arg in node.args for name in _callable_names(arg)
+        ]
+    return []
+
+
+def _traced_args(call):
+    """Function names handed to a tracer call, if statically nameable:
+    jit(f), shard_map(lambda …: g(…), ...), jit(partial(f, …))."""
+    t = terminal_name(call.func)
+    d = dotted_name(call.func) or ""
+    if t in TRACERS or d.endswith(".jit") or (
+        t == "partial" and call.args
+        and (dotted_name(call.args[0]) or "").endswith("jit")
+    ):
+        return [
+            name for arg in call.args for name in _callable_names(arg)
+        ]
+    return []
+
+
+def _decorator_roots(func):
+    """Whether the function's decorators trace it."""
+    for dec in func.decorator_list:
+        d = dotted_name(dec) or ""
+        if terminal_name(dec) in TRACERS or d.endswith(".jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            dd = dotted_name(dec.func) or ""
+            if terminal_name(dec.func) in TRACERS or dd.endswith(".jit"):
+                return True
+            if terminal_name(dec.func) == "partial" and dec.args and (
+                dotted_name(dec.args[0]) or ""
+            ).endswith("jit"):
+                return True
+    return False
+
+
+def check(tree, relpath):
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    # local bindings of names to lambdas/partials — the idiomatic
+    # ``fn = lambda s, b: resolve_batch(s, b, params); jax.jit(fn)``
+    # shape must still root resolve_batch
+    env = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, (ast.Lambda, ast.Call)):
+            env.setdefault(node.targets[0].id, node.value)
+
+    roots = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            frontier = list(_traced_args(node))
+            expanded = set()
+            while frontier:
+                name = frontier.pop()
+                if name in expanded:
+                    continue
+                expanded.add(name)
+                if name in defs:
+                    roots.add(name)
+                elif name in env:
+                    frontier.extend(_callable_names(env[name]))
+    for name, fn in defs.items():
+        if _decorator_roots(fn):
+            roots.add(name)
+
+    # bare-name call-graph reachability, module-local
+    reachable = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for node in ast.walk(defs[name]):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id in defs and node.func.id not in reachable:
+                frontier.append(node.func.id)
+
+    seen = set()
+    for name in sorted(reachable):
+        fn = defs[name]
+        for node in ast.walk(fn):
+            msg = None
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == "np":
+                msg = (f"np.{node.attr} in jit-reachable "
+                       f"function {name!r} — host numpy materializes at "
+                       "trace time; use jnp or move it out of the step")
+            elif isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if isinstance(node.func, ast.Name) and t in IO_CALLS:
+                    msg = (f"{t}() in jit-reachable function {name!r} "
+                           "fires at trace time only")
+                elif t == "TraceEvent":
+                    msg = (f"TraceEvent in jit-reachable function "
+                           f"{name!r} — tracing is host-side "
+                           "observability, it cannot run per step")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(
+                    node, ast.Assign
+                ) else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                        tgt.value, ast.Name
+                    ) and tgt.value.id == "self":
+                        msg = (f"jit-reachable function {name!r} "
+                               f"mutates self.{tgt.attr} — the write "
+                               "happens at trace time, not per step")
+            if msg is None:
+                continue
+            key = (node.lineno, msg)
+            if key not in seen:
+                seen.add(key)
+                yield Finding(RULE, relpath, node.lineno, msg)

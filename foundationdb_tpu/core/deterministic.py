@@ -1,0 +1,136 @@
+"""Injectable entropy + clock — the sim-determinism seam.
+
+Ref parity: FoundationDB's deterministic simulation works only because
+every source of nondeterminism the cluster can OBSERVE flows through
+``deterministicRandom()`` and ``g_network->now()``, which sim2 seeds and
+replays (flow/IRandom.h, fdbrpc/sim2.actor.cpp). Cluster-visible code
+never calls the OS clock or OS entropy directly; it asks the injected
+authority, so a seed replays byte-identically.
+
+This module is that authority for the Python port. Cluster-visible code
+draws randomness from a NAMED stream (``rng("proposer-id")``) and reads
+time via ``now()``:
+
+- **Production** (default): streams are seeded from OS entropy and
+  ``now()`` is the wall clock — behavior is unchanged from calling
+  ``random`` / ``time.time`` directly.
+- **Simulation**: ``sim/simulation.py`` calls ``seed(master_seed)`` and
+  ``set_clock(step_clock)`` at cluster build; every stream re-seeds to a
+  value derived from (master seed, stream name), so two same-seed runs
+  draw identical proposer ids, directory prefixes, idempotency ids, …
+
+Named streams (rather than one shared stream) keep call sites
+independent: adding a draw in one subsystem does not shift another
+subsystem's sequence, which keeps seed replays stable across unrelated
+code changes — the same reason the reference hands each actor its own
+DeterministicRandom fork.
+
+flowlint's FL001 rule enforces the seam: direct ``time.time()`` /
+``os.urandom`` / module-level ``random.*`` calls outside ``sim/`` (and
+this module) are findings. Deliberately non-deterministic sites —
+crypto material like the RPC auth nonce — stay on ``os.urandom`` with
+an inline ``# flowlint: disable=FL001`` and a stated reason: feeding an
+attacker-predictable seeded stream into authentication would be a
+vulnerability, and the sim never exercises the real transport.
+"""
+
+import random
+import threading
+import time
+
+
+class DeterminismRegistry:
+    """Named RNG streams + an injectable clock, one per process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._streams = {}
+        self._seed = None  # None = production mode (OS entropy)
+        self._clock = time.time
+
+    # ── entropy ──
+    def rng(self, name):
+        """The named stream (a persistent ``random.Random``). The same
+        name always returns the same object, so a later ``seed()``
+        re-seeds every stream handed out earlier — construction order
+        and seeding order cannot race."""
+        with self._lock:
+            stream = self._streams.get(name)
+            if stream is None:
+                if self._seed is None:
+                    stream = random.Random()  # OS-entropy seeded
+                else:
+                    stream = random.Random(f"{self._seed}:{name}")
+                self._streams[name] = stream
+            return stream
+
+    def token_bytes(self, n, name="token"):
+        """``n`` random bytes from a named stream (idempotency ids,
+        generated cluster ids). Deterministic under a seed; OS-entropy
+        quality in production. NOT for cryptographic material — auth
+        nonces must stay on ``os.urandom``."""
+        return self.rng(name).getrandbits(8 * n).to_bytes(n, "big")
+
+    def seed(self, master_seed):
+        """Enter deterministic mode: every existing stream re-seeds to
+        hash(master_seed, name); streams created later derive the same
+        way. Two processes seeding the same value draw identical
+        sequences from identically-named streams."""
+        with self._lock:
+            self._seed = master_seed
+            for name, stream in self._streams.items():
+                stream.seed(f"{master_seed}:{name}")
+
+    def unseed(self):
+        """Back to production mode: streams re-seed from OS entropy."""
+        with self._lock:
+            self._seed = None
+            for stream in self._streams.values():
+                stream.seed()
+
+    @property
+    def seeded(self):
+        return self._seed is not None
+
+    # ── time ──
+    def now(self):
+        """The injected clock (wall clock in production; the sim's step
+        clock under simulation)."""
+        return self._clock()
+
+    def set_clock(self, fn):
+        self._clock = fn
+
+    def reset_clock(self):
+        self._clock = time.time
+
+
+_registry = DeterminismRegistry()
+
+
+def registry():
+    return _registry
+
+
+def rng(name):
+    return _registry.rng(name)
+
+
+def token_bytes(n, name="token"):
+    return _registry.token_bytes(n, name)
+
+
+def seed(master_seed):
+    _registry.seed(master_seed)
+
+
+def unseed():
+    _registry.unseed()
+
+
+def now():
+    return _registry.now()
+
+
+def set_clock(fn):
+    _registry.set_clock(fn)

@@ -14,9 +14,9 @@ Metadata schema (all under node_subspace):
   root[b'hca'][recent][c]       = candidate c claimed
 """
 
-import random
 import struct
 
+from foundationdb_tpu.core import deterministic
 from foundationdb_tpu.core.keys import strinc
 from foundationdb_tpu.layers import tuple as fdbtuple
 from foundationdb_tpu.layers.subspace import Subspace
@@ -38,7 +38,10 @@ class HighContentionAllocator:
     def __init__(self, subspace: Subspace):
         self.counters = subspace[0]
         self.recent = subspace[1]
-        self._rng = random.Random()
+        # candidate draws come from the injected stream: a seeded sim
+        # allocates identical prefixes run after run (the HCA's window
+        # draws are cluster-visible state), production stays OS-random
+        self._rng = deterministic.rng("directory-hca")
 
     def allocate(self, tr):
         while True:

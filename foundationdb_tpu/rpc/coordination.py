@@ -13,11 +13,12 @@ death exactly as in-process quorums tolerate killed replicas.
 
 Ballot striding across independent proposer processes uses a random
 64-bit proposer id with a 2^64 stride: ballots never collide without
-needing the proposers to know each other.
+needing the proposers to know each other. The id is drawn from the
+injectable determinism registry (core/deterministic.py) so a seeded
+simulation replays the same proposer ids run after run.
 """
 
-import random
-
+from foundationdb_tpu.core import deterministic
 from foundationdb_tpu.rpc.transport import (
     ConnectionLost,
     RemoteError,
@@ -30,6 +31,12 @@ from foundationdb_tpu.server.coordination import (
 )
 
 BALLOT_STRIDE = 1 << 64
+
+
+def draw_proposer_id():
+    """A fresh 64-bit proposer id from the injected entropy stream —
+    deterministic under a sim seed, OS-random in production."""
+    return deterministic.rng("proposer-id").getrandbits(64)
 
 
 class CoordinatorService:
@@ -107,7 +114,7 @@ def remote_quorum(addresses, proposer_id=None, secret=None):
     handlers). Proposer ids are drawn at random from a 64-bit space so
     independent recovering processes stride disjoint ballot sequences."""
     if proposer_id is None:
-        proposer_id = random.getrandbits(64)
+        proposer_id = draw_proposer_id()
     coords = [RemoteCoordinator(a, secret=secret) for a in addresses]
     return CoordinationQuorum(
         coords, proposer_id=proposer_id, n_proposers=BALLOT_STRIDE

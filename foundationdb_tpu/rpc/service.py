@@ -25,11 +25,11 @@ broken proxy connections):
 import dataclasses
 import itertools
 import os
-import random
 import string
 import threading
 import time
 
+from foundationdb_tpu.core import deterministic
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.core.options import Knobs
 from foundationdb_tpu.rpc.transport import (
@@ -46,8 +46,11 @@ def write_cluster_file(path, addresses, description="tpu", cluster_id=None):
     """``description:id@host:port,host:port`` (ref: ClusterConnectionFile
     format in fdbclient/ConnectionString)."""
     if cluster_id is None:
+        # drawn from the injected stream so a seeded sim writes the same
+        # cluster file every run (FL001: cluster-visible entropy)
+        id_rng = deterministic.rng("cluster-id")
         cluster_id = "".join(
-            random.choice(string.ascii_lowercase + string.digits)
+            id_rng.choice(string.ascii_lowercase + string.digits)
             for _ in range(8)
         )
     body = f"{description}:{cluster_id}@{','.join(addresses)}\n"

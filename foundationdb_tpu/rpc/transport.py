@@ -30,7 +30,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.rpc import wire
-from foundationdb_tpu.utils.trace import TraceEvent
+from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
 
 MAX_FRAME = 64 * 1024 * 1024
 _AUTH_CONTEXT = b"fdbtpu-rpc-auth-v1:"
@@ -52,7 +52,10 @@ def _send_frame(sock, lock, payload: bytes):
         raise ValueError(f"frame too large: {len(payload)}")
     msg = struct.pack(">I", len(payload)) + payload
     with lock:
-        sock.sendall(msg)
+        # this per-socket lock EXISTS to serialize whole-frame sends —
+        # interleaved partial frames would corrupt the stream; nothing
+        # else is ever guarded by it, so no convoy can form
+        sock.sendall(msg)  # flowlint: disable=FL003
 
 
 def _recv_exact(sock, n):
@@ -153,7 +156,9 @@ class RpcServer:
         """Challenge/response before the first request frame. The
         handshake runs under a timeout so an idle port-scanner cannot
         park a connection thread forever."""
-        nonce = os.urandom(16)
+        # crypto material must NOT come from the seeded determinism
+        # registry: a replayable nonce is a replayable handshake
+        nonce = os.urandom(16)  # flowlint: disable=FL001
         _send_frame(sock, send_lock, nonce)
         sock.settimeout(_AUTH_HANDSHAKE_TIMEOUT_S)
         try:
@@ -214,6 +219,11 @@ class RpcServer:
         except FDBError as e:
             reply = wire.dumps(("r", seq, False, e))
         except Exception as e:  # generic remote failure
+            # the client only receives a flattened string — the server
+            # trace is the record with the real type/context (FL005)
+            TraceEvent("RpcHandlerError", severity=SEV_ERROR).detail(
+                method=method, etype=type(e).__name__,
+                error=str(e)[:200]).log()
             reply = wire.dumps(("r", seq, False, f"{type(e).__name__}: {e}"))
         try:
             _send_frame(sock, send_lock, reply)

@@ -37,7 +37,7 @@ import time
 from collections import deque
 
 from foundationdb_tpu.core.errors import FDBError
-from foundationdb_tpu.utils.trace import StageStats
+from foundationdb_tpu.utils.trace import SEV_ERROR, StageStats, TraceEvent
 
 
 _UNSET = object()
@@ -230,6 +230,11 @@ class BatchingCommitProxy:
                     try:
                         eligible = self.inner.pipeline_eligible(reqs)
                     except Exception as e:
+                        TraceEvent("CommitBatchError",
+                                   severity=SEV_ERROR).detail(
+                            phase="eligibility",
+                            etype=type(e).__name__,
+                            error=str(e)[:200]).log()
                         self._fail_chunks(group, e)
                         continue
                     if eligible:
@@ -242,6 +247,11 @@ class BatchingCommitProxy:
                             # begin died outside its own guards (e.g. a
                             # dedupe/storage TOCTOU): same contract as a
                             # failed commit_batches — futures resolve
+                            TraceEvent("CommitBatchError",
+                                       severity=SEV_ERROR).detail(
+                                phase="pipeline_begin",
+                                etype=type(e).__name__,
+                                error=str(e)[:200]).log()
                             self._fail_chunks(group, e)
                         continue
                 # serial fallback (lock/dedupe-hit/overload/fleet of
@@ -252,6 +262,11 @@ class BatchingCommitProxy:
                 try:
                     results_list = self.inner.commit_batches(reqs)
                 except Exception as e:
+                    TraceEvent("CommitBatchError",
+                               severity=SEV_ERROR).detail(
+                        phase="backlog",
+                        etype=type(e).__name__,
+                        error=str(e)[:200]).log()
                     self._fail_chunks(group, e)
                     continue
                 txns = conflicts = 0
@@ -275,6 +290,11 @@ class BatchingCommitProxy:
                     # the remaining chunks still deserve their shot. The
                     # pipeline may or may not have made the chunk durable
                     # — exactly what commit_unknown_result (1021) means.
+                    TraceEvent("CommitBatchError",
+                               severity=SEV_ERROR).detail(
+                        phase="batch",
+                        etype=type(e).__name__,
+                        error=str(e)[:200]).log()
                     self._fail_chunks([chunk], e)
                     continue
                 self._settle(chunk, results)
@@ -328,11 +348,15 @@ class BatchingCommitProxy:
                 self._inflight_cv.wait(timeout=1.0)
         t0 = time.perf_counter()
         pgroup = self.inner.commit_batches_begin(reqs)
-        self.stages.add("pack", time.perf_counter() - t0)
+        pack_s = time.perf_counter() - t0
+        # hand the group to the apply worker BEFORE any other fallible
+        # call (FL002): once queued, stage C settles its futures even if
+        # this thread dies; the stage timer records after the handoff
         with self._inflight_cv:
             self._inflight.append((group_chunks, pgroup))
             self._occ_transition(len(self._inflight))
             self._inflight_cv.notify_all()
+        self.stages.add("pack", pack_s)
 
     def drain_pipeline(self):
         """Block until every in-flight group has settled (ordering
@@ -359,11 +383,17 @@ class BatchingCommitProxy:
                 # drain_pipeline and every waiting client). Futures are
                 # re-set defensively — set() on a settled future is a
                 # no-op-safe overwrite the waiters never observe twice.
+                TraceEvent("CommitApplyWorkerError",
+                           severity=SEV_ERROR).detail(
+                    etype=type(e).__name__, error=str(e)[:200]).log()
                 self.last_batch_error = e
                 try:
                     self._fail_chunks(group_chunks, e)
-                except Exception:
-                    pass
+                except Exception as e2:
+                    TraceEvent("CommitSettleError",
+                               severity=SEV_ERROR).detail(
+                        etype=type(e2).__name__,
+                        error=str(e2)[:200]).log()
             finally:
                 with self._inflight_cv:
                     self._inflight.popleft()
@@ -414,7 +444,10 @@ class BatchingCommitProxy:
 
     def _batcher_loop(self):
         while True:
-            with self._lock:
+            # acquire via the Condition (it wraps self._lock — the same
+            # mutex): waiting on the object we hold keeps the
+            # release-while-parked relationship explicit (FL003)
+            with self._wake:
                 while not self._pending and not self._closed:
                     self._wake.wait()
                 if self._closed and not self._pending:
@@ -431,6 +464,9 @@ class BatchingCommitProxy:
                 except BaseException as e:  # pragma: no cover — last resort
                     # _run_batch resolves futures itself; this guard only
                     # keeps the batcher alive if future.set's internals fail
+                    TraceEvent("CommitBatcherError",
+                               severity=SEV_ERROR).detail(
+                        etype=type(e).__name__, error=str(e)[:200]).log()
                     self.last_batch_error = e
 
     def fail_pending(self, error):

@@ -12,6 +12,8 @@ kill/recruit rounds, tools/cli.py exposes it).
 Returns a list of human-readable error strings — empty means consistent.
 """
 
+from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
+
 SYSTEM_END = b"\xff\xff"  # past user + system keys (engine meta excluded)
 
 
@@ -60,6 +62,13 @@ def consistency_check(cluster, max_keys_per_shard=None):
                     begin, end, version, limit=max_keys_per_shard,
                 )
             except Exception as e:
+                # the error lands in the report AND the trace stream: a
+                # sim run greps traces for forensics, and an operator's
+                # consistencycheck may summarize away the detail (FL005)
+                TraceEvent("ConsistencyCheckReadError",
+                           severity=SEV_ERROR).detail(
+                    shard=i, storage=sid, version=version,
+                    etype=type(e).__name__, error=str(e)[:200]).log()
                 errors.append(
                     f"shard {i} replica {sid} unreadable at v{version}: {e}"
                 )

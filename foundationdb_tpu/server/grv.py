@@ -122,7 +122,10 @@ class BatchingGrvProxy:
     def _grant_loop(self):
         sleep_s = self.interval_s
         while True:
-            with self._lock:
+            # acquire via the Condition (it wraps self._lock, so this IS
+            # the same mutex): waiting on the object we hold makes the
+            # release-while-parked relationship explicit (FL003)
+            with self._wake:
                 while not (self._queues["default"] or self._queues["batch"]
                            or self._closed):
                     self._wake.wait()

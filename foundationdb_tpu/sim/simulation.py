@@ -17,6 +17,7 @@ import os
 import random
 import tempfile
 
+from foundationdb_tpu.core import deterministic
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.server.cluster import Cluster
 from foundationdb_tpu.server.kvstore import open_engine
@@ -107,6 +108,11 @@ class Simulation:
         self.seed = seed
         self.engine_kind = engine  # "memory" | "versioned" | "redwood" | "sqlite"
         self.rng = random.Random(seed)
+        # seed the process-wide determinism registry: cluster-visible
+        # entropy (proposer ids, directory HCA draws, idempotency ids,
+        # cluster-file ids) replays identically for the same seed — the
+        # registry is exactly the seam flowlint FL001 enforces
+        deterministic.seed(seed)
         self.buggify = Buggify(seed=seed, enabled=buggify)
         self.crash_p = crash_p
         self.n_resolvers = n_resolvers
@@ -150,6 +156,9 @@ class Simulation:
         from foundationdb_tpu.utils.trace import global_trace_log
 
         global_trace_log().clock = lambda: self.steps
+        # the registry's injected clock follows simulated time too, so
+        # deterministic.now() readers replay with the schedule
+        deterministic.set_clock(lambda: self.steps * self.SIM_DT)
         n_storage = self.cluster_kwargs.get("n_storage", 1)
         self.cluster = Cluster(
             wal_path=self._wal_path,

@@ -546,9 +546,13 @@ class Transaction:
 
     def _ensure_idempotency_id(self):
         if self._idempotency_id is None and self._auto_idempotency:
-            import os as _os
+            from foundationdb_tpu.core import deterministic
 
-            self._idempotency_id = _os.urandom(16)
+            # injected entropy: a seeded sim mints the same ids every
+            # run, so 1021-retry histories replay byte-identically
+            self._idempotency_id = deterministic.token_bytes(
+                16, name="idempotency-id"
+            )
         return self._idempotency_id
 
     def _finish_commit(self, result):

@@ -24,6 +24,8 @@ def test_compact_summary_is_small_and_headline_last():
         # commit-pipeline stage timings (server/batcher.py StageStats)
         "stage_pack_ms": 1.2, "stage_resolve_ms": 3.4,
         "stage_apply_ms": 2.1, "pipeline_depth_effective": 1.8,
+        # static-analysis debt (analysis/flowlint.py): 0 must still ride
+        "flowlint_findings": 0,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -46,6 +48,8 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["stage_resolve_ms"] == 3.4
     assert line["stage_apply_ms"] == 2.1
     assert line["pipeline_depth_effective"] == 1.8
+    # lint debt rides the summary — and a clean tree's 0 is not dropped
+    assert line["flowlint_findings"] == 0
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -65,6 +69,13 @@ def test_compact_summary_never_exceeds_tail_budget():
     assert len(json.dumps(line)) < 1900
     assert line["value"] == 1.0
     assert list(line.keys())[-3:] == ["metric", "value", "vs_baseline"]
+
+
+def test_flowlint_findings_gauge_matches_the_tree():
+    """The bench's lint-debt gauge is live (runs the real pass over the
+    installed package) and the shipped tree is clean."""
+    n = bench._flowlint_findings()
+    assert n == 0, f"shipped tree carries {n} flowlint finding(s)"
 
 
 def test_device_env_restores_original_platform(monkeypatch):

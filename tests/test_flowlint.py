@@ -1,0 +1,425 @@
+"""flowlint rule fixtures: each rule must flag its violating snippet,
+pass its compliant twin, honor inline suppression, and round-trip
+through the baseline. These are the linter's OWN tier-1 tests — the
+tree-wide gate lives in test_flowlint_tree.py."""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from foundationdb_tpu.analysis import flowlint  # noqa: E402
+
+
+def lint(path, src):
+    return flowlint.lint_source(path, textwrap.dedent(src))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ───────────────────────────── FL001 ─────────────────────────────
+def test_fl001_flags_ambient_entropy_and_wall_clock():
+    findings = lint("server/foo.py", """
+        import os
+        import random
+        import time
+
+        def f():
+            a = time.time()
+            b = os.urandom(8)
+            c = random.getrandbits(64)
+            d = random.Random()
+            return a, b, c, d
+    """)
+    assert rules_of(findings) == ["FL001"] * 4
+
+
+def test_fl001_allows_injected_and_seeded_sources():
+    findings = lint("server/foo.py", """
+        import random
+        import time
+
+        from foundationdb_tpu.core import deterministic
+
+        def f(seed):
+            a = time.monotonic()
+            b = time.perf_counter()
+            c = deterministic.rng("stream").getrandbits(64)
+            d = random.Random(seed)  # explicitly seeded: replayable
+            return a, b, c, d
+    """)
+    assert findings == []
+
+
+def test_fl001_flags_from_import_of_random():
+    findings = lint("server/foo.py", "from random import choice\n")
+    assert rules_of(findings) == ["FL001"]
+
+
+def test_fl001_exempts_sim_and_the_seam_itself():
+    src = """
+        import random
+
+        def f():
+            return random.random()
+    """
+    assert lint("sim/foo.py", src) == []
+    assert lint("core/deterministic.py", src) == []
+    assert rules_of(lint("layers/foo.py", src)) == ["FL001"]
+
+
+def test_fl001_inline_suppression_honored():
+    findings = lint("rpc/foo.py", """
+        import os
+
+        def f():
+            return os.urandom(16)  # flowlint: disable=FL001
+    """)
+    assert findings == []
+
+
+def test_fl001_suppression_on_preceding_line_honored():
+    findings = lint("rpc/foo.py", """
+        import os
+
+        def f():
+            # flowlint: disable=FL001
+            return os.urandom(16)
+    """)
+    assert findings == []
+
+
+# ───────────────────────────── FL002 ─────────────────────────────
+def test_fl002_flags_risky_call_before_settlement():
+    findings = lint("server/foo.py", """
+        def f(self, request):
+            fut = CommitFuture()
+            self.dispatch(request)
+            fut.set(1)
+            return fut
+    """)
+    assert rules_of(findings) == ["FL002"]
+
+
+def test_fl002_flags_never_settled_handle():
+    findings = lint("server/foo.py", """
+        def f(self, batches):
+            handle = self.resolver.resolve_many(batches, lazy=True)
+            self.counter += 1
+    """)
+    assert rules_of(findings) == ["FL002"]
+
+
+def test_fl002_flags_discarded_acquisition():
+    findings = lint("server/foo.py", """
+        def f(self):
+            CommitFuture()
+    """)
+    assert rules_of(findings) == ["FL002"]
+
+
+def test_fl002_clean_when_settled_immediately():
+    findings = lint("server/foo.py", """
+        def f(self, request):
+            fut = CommitFuture()
+            fut.set(self.compute(request))
+            return fut
+    """)
+    assert findings == []
+
+
+def test_fl002_clean_when_handed_off_before_risk():
+    findings = lint("server/foo.py", """
+        def f(self, request):
+            fut = CommitFuture()
+            self.pending.append((request, fut))
+            self.wake.notify()
+            return fut
+    """)
+    assert findings == []
+
+
+def test_fl002_clean_when_guarded_by_settling_try():
+    findings = lint("server/foo.py", """
+        def f(self, request):
+            fut = CommitFuture()
+            try:
+                self.dispatch(request)
+            except Exception as e:
+                fut.set(e)
+            fut.set(1)
+            return fut
+    """)
+    assert findings == []
+
+
+def test_fl002_sync_resolve_many_is_not_an_acquisition():
+    findings = lint("server/foo.py", """
+        def f(self, batches):
+            statuses = self.resolver.resolve_many(batches)
+            self.apply(statuses)
+    """)
+    assert findings == []
+
+
+# ───────────────────────────── FL003 ─────────────────────────────
+def test_fl003_flags_foreign_wait_under_lock():
+    findings = lint("server/foo.py", """
+        def f(self):
+            with self._lock:
+                self._other_event.wait()
+    """)
+    assert rules_of(findings) == ["FL003"]
+
+
+def test_fl003_flags_socket_send_and_sleep_under_lock():
+    findings = lint("rpc/foo.py", """
+        import time
+
+        def f(self, sock, msg):
+            with self._send_lock:
+                sock.sendall(msg)
+            with self._mu:
+                time.sleep(0.1)
+    """)
+    assert rules_of(findings) == ["FL003", "FL003"]
+
+
+def test_fl003_flags_sync_resolve_many_under_lock():
+    findings = lint("server/foo.py", """
+        def f(self, batches):
+            with self._commit_mu:
+                return self.resolver.resolve_many(batches)
+    """)
+    assert rules_of(findings) == ["FL003"]
+
+
+def test_fl003_allows_condition_wait_on_the_held_object():
+    findings = lint("server/foo.py", """
+        def f(self):
+            with self._cond:
+                self._cond.wait_for(lambda: self.done)
+            cond = self.proxy._done_cond
+            with cond:
+                cond.wait(timeout=1.0)
+    """)
+    assert findings == []
+
+
+def test_fl003_allows_lazy_resolve_many_and_plain_calls_under_lock():
+    findings = lint("server/foo.py", """
+        def f(self, batches):
+            with self._commit_mu:
+                handle = self.resolver.resolve_many(batches, lazy=True)
+                self.note_dispatch(handle)
+            return handle
+    """)
+    assert findings == []
+
+
+def test_fl003_ignores_non_lock_contexts():
+    findings = lint("server/foo.py", """
+        def f(self, path, event):
+            with open(path) as fh:
+                event.wait()
+                return fh.read()
+    """)
+    assert findings == []
+
+
+# ───────────────────────────── FL004 ─────────────────────────────
+FL004_SRC = """
+    import jax
+    import numpy as np
+
+    def helper(x):
+        np.asarray(x)
+        return x
+
+    def step(state, batch):
+        print("tracing")
+        return helper(state)
+
+    def untraced(x):
+        np.asarray(x)
+        return x
+
+    _step = jax.jit(step)
+"""
+
+
+def test_fl004_flags_host_effects_in_reachable_functions():
+    findings = lint("ops/foo.py", FL004_SRC)
+    msgs = " | ".join(f.message for f in findings)
+    assert rules_of(findings) == ["FL004", "FL004"]
+    assert "np.asarray" in msgs and "'helper'" in msgs  # via call graph
+    assert "print()" in msgs and "'step'" in msgs
+    assert "untraced" not in msgs  # unreachable from any jit root
+
+
+def test_fl004_only_applies_to_device_dirs():
+    assert lint("server/foo.py", FL004_SRC) == []
+
+
+def test_fl004_roots_through_lambda_and_decorator():
+    findings = lint("ops/foo.py", """
+        import jax
+
+        def kernel(state, batch, params):
+            state.cache = batch
+            return state
+
+        fn = lambda s, b: kernel(s, b, 3)
+        _ = jax.jit(fn, donate_argnums=(0,))
+
+        @jax.jit
+        def decorated(self, x):
+            self.hits += 1
+            return x
+    """)
+    assert rules_of(findings) == ["FL004"]
+    assert "self.hits" in findings[0].message
+
+
+def test_fl004_clean_kernel():
+    findings = lint("ops/foo.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def step(state, batch):
+            return jnp.maximum(state, batch)
+
+        _step = jax.jit(step)
+    """)
+    assert findings == []
+
+
+# ───────────────────────────── FL005 ─────────────────────────────
+def test_fl005_flags_swallowing_blanket_except_in_loop():
+    findings = lint("server/foo.py", """
+        def drain(self):
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    pass
+    """)
+    assert rules_of(findings) == ["FL005"]
+
+
+def test_fl005_accepts_reraise_or_sev_error_trace():
+    findings = lint("server/foo.py", """
+        from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
+
+        def drain(self):
+            while True:
+                try:
+                    self.step()
+                except BaseException as e:
+                    TraceEvent("DrainError", severity=SEV_ERROR).detail(
+                        etype=type(e).__name__).log()
+
+        def serve(self):
+            for req in self.queue:
+                try:
+                    self.handle(req)
+                except Exception:
+                    raise
+    """)
+    assert findings == []
+
+
+def test_fl005_typed_handlers_and_non_loop_handlers_pass():
+    findings = lint("rpc/foo.py", """
+        def drain(self):
+            while True:
+                try:
+                    self.step()
+                except (ConnectionError, OSError):
+                    continue
+
+        def once(self):
+            try:
+                self.step()
+            except Exception:
+                return None
+    """)
+    assert findings == []
+
+
+def test_fl005_out_of_scope_dirs_pass():
+    findings = lint("layers/foo.py", """
+        def drain(self):
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    pass
+    """)
+    assert findings == []
+
+
+# ─────────────────────── engine: suppression + baseline ───────────────────
+def test_file_level_suppression():
+    findings = lint("server/foo.py", """
+        # flowlint: disable-file=FL001
+        import os
+
+        def f():
+            return os.urandom(4) + os.urandom(4)
+    """)
+    assert findings == []
+
+
+def test_baseline_round_trip(tmp_path):
+    src = """
+        import os
+
+        def f():
+            return os.urandom(8)
+    """
+    findings = lint("server/foo.py", src)
+    assert rules_of(findings) == ["FL001"]
+    path = tmp_path / "baseline.txt"
+    path.write_text(flowlint.format_baseline(findings))
+    baseline = flowlint.load_baseline(str(path))
+    new, old, stale = flowlint.split_by_baseline(findings, baseline)
+    assert new == [] and len(old) == 1 and stale == []
+    # the baseline key ignores line numbers: shifting the finding down
+    # (edits above it) keeps the entry valid
+    shifted = lint("server/foo.py", "\n\n" + textwrap.dedent(src))
+    new, old, stale = flowlint.split_by_baseline(shifted, baseline)
+    assert new == [] and len(old) == 1
+    # fixing the finding leaves a STALE entry the gate reports
+    new, old, stale = flowlint.split_by_baseline([], baseline)
+    assert new == [] and old == [] and len(stale) == 1
+    # a second identical finding in the same file is NEW (multiset)
+    doubled = findings + findings
+    new, old, stale = flowlint.split_by_baseline(doubled, baseline)
+    assert len(new) == 1 and len(old) == 1
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    bad = tmp_path / "pkg" / "server"
+    bad.mkdir(parents=True)
+    (bad / "leaky.py").write_text(
+        "import os\n\n\ndef f():\n    return os.urandom(4)\n"
+    )
+    baseline = tmp_path / "baseline.txt"
+    root = str(tmp_path / "pkg")
+    rc = flowlint.main([root, "--baseline", str(baseline)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "FL001" in out and "leaky.py" in out
+    # grandfather it, then the same tree is clean
+    assert flowlint.main(
+        [root, "--baseline", str(baseline), "--fix-baseline"]
+    ) == 0
+    assert flowlint.main([root, "--baseline", str(baseline)]) == 0
+    # --no-baseline still reports it
+    assert flowlint.main(
+        [root, "--baseline", str(baseline), "--no-baseline"]
+    ) == 1

@@ -1,0 +1,68 @@
+"""Tier-1 gate: flowlint over the whole package must be clean.
+
+This is the CI tooth of the static pass — any new FL001–FL005 finding
+(beyond the checked-in baseline) fails the suite, exactly like the
+actor compiler failing the build on a concurrency-rule violation.
+Re-introducing, say, ``random.getrandbits`` in rpc/coordination.py
+makes tier-1 fail here."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from foundationdb_tpu.analysis import flowlint  # noqa: E402
+
+
+def _fmt(findings):
+    return "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_package_tree_has_no_new_findings():
+    findings = flowlint.lint_paths([flowlint.package_dir()])
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    new, _old, stale = flowlint.split_by_baseline(findings, baseline)
+    assert not new, (
+        "flowlint found new invariant violations (fix them, or for a "
+        "deliberate pattern add an inline `# flowlint: disable=FL00x` "
+        "with the reason; FL004 debt may be baselined via "
+        "--fix-baseline):\n" + _fmt(new)
+    )
+    # fixed findings must be RECORDED: a stale baseline entry means the
+    # tree improved — run --fix-baseline so the debt number goes down
+    assert not stale, (
+        "stale baseline entries (already fixed in the tree) — run "
+        "python -m foundationdb_tpu.analysis.flowlint --fix-baseline:\n"
+        + "\n".join(stale)
+    )
+
+
+def test_baseline_is_empty_for_hard_rules():
+    """The shipped contract: FL001/FL002/FL003/FL005 carry NO
+    grandfathered findings — only FL004 (jit purity) may hold debt."""
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    hard = [k for k in baseline if not k.startswith("FL004\t")]
+    assert hard == [], f"hard-rule findings grandfathered: {hard}"
+
+
+def test_reintroducing_ambient_entropy_is_caught():
+    """The acceptance probe, without mutating the tree: the OLD
+    ``random.getrandbits(64)`` form of rpc/coordination.py must be a
+    fresh FL001 finding (nothing in the baseline shields it)."""
+    path = os.path.join(flowlint.package_dir(), "rpc", "coordination.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    assert "deterministic.rng" in src  # the migrated form ships
+    regressed = src.replace(
+        'deterministic.rng("proposer-id").getrandbits(64)',
+        "random.getrandbits(64)",
+    )
+    assert regressed != src, "rewrite did not bite — update the probe"
+    findings = flowlint.lint_source("rpc/coordination.py", regressed)
+    fl001 = [f for f in findings if f.rule == "FL001"]
+    assert fl001, "regressed coordination.py must trip FL001"
+    baseline = flowlint.load_baseline(flowlint.default_baseline_path())
+    new, _old, _stale = flowlint.split_by_baseline(fl001, baseline)
+    assert new, "baseline must not shield the regression"
