@@ -5,21 +5,30 @@ Lives in core (not server/proxy.py, which re-exports it) so that
 dependency-light consumers — the wire codec, coordinator-only server
 processes — can name the type without pulling the resolver stack (and
 with it JAX) into their import graph.
+
+``flat_conflicts`` (core/flatpack.py) is the columnar fast path: the
+client pre-encodes its conflict ranges into limb-entry blobs, and the
+wire's columnar frame ships ONLY those — the byte-pair range lists are
+then reconstructed lazily, on the rare paths that still want them
+(cpu-backend resolution, conflicting-keys reports). Both forms describe
+the same ranges; the flat one exists only for in-capacity keys, so the
+reconstruction is exact.
 """
 
 
 class CommitRequest:
-    __slots__ = ("read_version", "mutations", "read_conflict_ranges",
-                 "write_conflict_ranges", "report_conflicting_keys",
-                 "lock_aware", "idempotency_id")
+    __slots__ = ("read_version", "mutations", "_read_conflict_ranges",
+                 "_write_conflict_ranges", "report_conflicting_keys",
+                 "lock_aware", "idempotency_id", "flat_conflicts")
 
     def __init__(self, read_version, mutations, read_conflict_ranges,
                  write_conflict_ranges, report_conflicting_keys=False,
-                 lock_aware=False, idempotency_id=None):
+                 lock_aware=False, idempotency_id=None,
+                 flat_conflicts=None):
         self.read_version = read_version
         self.mutations = mutations
-        self.read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
-        self.write_conflict_ranges = write_conflict_ranges
+        self._read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
+        self._write_conflict_ranges = write_conflict_ranges
         self.report_conflicting_keys = report_conflicting_keys
         # ref: FDBTransactionOptions LOCK_AWARE — this txn commits even
         # while the database is locked (lockDatabase in ManagementAPI)
@@ -29,3 +38,42 @@ class CommitRequest:
         # the mutations and dedupes resubmissions, so a retry after 1021
         # cannot double-apply
         self.idempotency_id = idempotency_id
+        self.flat_conflicts = flat_conflicts
+
+    @property
+    def read_conflict_ranges(self):
+        r = self._read_conflict_ranges
+        if r is None:
+            r = self._read_conflict_ranges = self._from_flat("read")
+        return r
+
+    @read_conflict_ranges.setter
+    def read_conflict_ranges(self, v):
+        self._read_conflict_ranges = v
+
+    @property
+    def write_conflict_ranges(self):
+        w = self._write_conflict_ranges
+        if w is None:
+            w = self._write_conflict_ranges = self._from_flat("write")
+        return w
+
+    @write_conflict_ranges.setter
+    def write_conflict_ranges(self, v):
+        self._write_conflict_ranges = v
+
+    def _from_flat(self, side):
+        """Reconstruct a byte-pair range list from the columnar form (a
+        request decoded from the wire's columnar frame carries only
+        that). Point order may differ from the client's original list —
+        the resolver is order-independent within a transaction."""
+        f = self.flat_conflicts
+        if f is None:
+            return []
+        from foundationdb_tpu.core import flatpack
+
+        if side == "read":
+            return flatpack.decode_side(
+                f.read_point_blob, f.read_range_blob, f.num_limbs)
+        return flatpack.decode_side(
+            f.write_point_blob, f.write_range_blob, f.num_limbs)

@@ -28,6 +28,15 @@ class Knobs:
     # "auto" = on TPU backends, "on" = everywhere (interpreter off-TPU,
     # for differential tests), "off" = always the jnp lanes
     pallas_ring: str = "auto"
+    # commit-path host packing (core/flatpack.py): "flat" = the client
+    # pre-encodes conflict ranges into columnar limb blobs and the
+    # proxy/packer consume them without per-txn Python ("legacy" keeps
+    # the TxnRequest object path). Flat engages per batch only when
+    # every request carries matching-width blobs and the resolver
+    # accepts them (tpu/native, single resolver); everything else
+    # falls back to legacy with identical packed arrays
+    # (tests/test_packing_flat.py).
+    commit_pack_path: str = "flat"
 
     # --- versions / MVCC ---
     versions_per_second: int = 1_000_000

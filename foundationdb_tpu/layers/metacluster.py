@@ -27,7 +27,7 @@ management row says which step committed last).
 
 import json
 
-from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.keys import strinc
 from foundationdb_tpu.layers.tenant import (
     TENANT_GROUP_PREFIX,
@@ -75,24 +75,50 @@ class Metacluster:
 
     def register_data_cluster(self, name, db, capacity=100):
         """A data cluster must be tenant-free and not already part of a
-        metacluster (ref: registerCluster's emptiness check). The
-        management row commits FIRST: a failed data-side mark then
-        rolls the row back, so neither side is left bricked."""
+        metacluster (ref: registerCluster's emptiness check).
+
+        Two transactions on two clusters cannot be atomic, so the
+        registry row commits FIRST in state "registering" (mirroring
+        create_tenant's state machine), the data-side mark commits
+        second, and only then does the row flip to "ready". A crash in
+        the window leaves a resumable "registering" row — re-calling
+        register_data_cluster picks up where the crash left off instead
+        of failing cluster_already_registered until an operator runs
+        remove_data_cluster — and create_tenant never assigns onto a
+        cluster that hasn't reached "ready". A data cluster that
+        REFUSES its mark (it belongs to another metacluster) still
+        rolls the row back: nothing is half-joined."""
         name = bytes(name)
         if TenantManagement.list_tenants(db):
             raise err("cluster_not_empty")
 
         def txn(tr):
             key = DATA_CLUSTER_PREFIX + name
-            if tr.get(key) is not None:
-                raise err("cluster_already_registered")
+            row = tr.get(key)
+            if row is not None:
+                meta = json.loads(row)
+                # rows from before the state field are fully registered
+                if meta.get("state", "ready") != "registering":
+                    raise err("cluster_already_registered")
+                # crashed registration: resume it (refresh capacity to
+                # this call's request; tenants is still 0 — the cluster
+                # was never assignable)
+                meta["capacity"] = capacity
+                tr.set(key, json.dumps(meta).encode())
+                return
             tr.set(key, json.dumps(
-                {"capacity": capacity, "tenants": 0}).encode())
+                {"capacity": capacity, "tenants": 0,
+                 "state": "registering"}).encode())
 
         self.db.run(txn)
 
         def mark(tr):
-            if tr.get(REGISTRATION_KEY) is not None:
+            reg = tr.get(REGISTRATION_KEY)
+            if reg is not None:
+                meta = json.loads(reg)
+                if (meta.get("role") == "data" and
+                        meta.get("name", "").encode("latin-1") == name):
+                    return  # our own mark from a crashed attempt
                 raise err("cluster_already_registered")
             tr.set(REGISTRATION_KEY, json.dumps(
                 {"role": "data", "name": name.decode("latin-1")}
@@ -100,12 +126,23 @@ class Metacluster:
 
         try:
             db.run(mark)
-        except BaseException:
-            # undo the registry row: the data cluster refused its mark
-            # (already part of a metacluster) — nothing is half-joined
+        except FDBError:
+            # the data cluster REFUSED its mark (already part of a
+            # metacluster): undo the registry row — nothing half-joined.
+            # Non-FDB failures (crash/outage shapes) deliberately leave
+            # the "registering" row: a retry resumes it, exactly like a
+            # process crash would have.
             self.db.run(
                 lambda tr: tr.clear(DATA_CLUSTER_PREFIX + name))
             raise
+
+        def ready(tr):
+            key = DATA_CLUSTER_PREFIX + name
+            meta = json.loads(tr.get(key))
+            meta["state"] = "ready"
+            tr.set(key, json.dumps(meta).encode())
+
+        self.db.run(ready)
         self.databases[name] = db
 
     def attach_data_cluster(self, name, db):
@@ -178,6 +215,10 @@ class Metacluster:
             best, best_meta, best_load = None, None, None
             for k, v in rows:
                 meta = json.loads(v)
+                if meta.get("state", "ready") != "ready":
+                    # mid-registration: its data-side mark may not
+                    # exist yet — never assign tenants onto it
+                    continue
                 if meta["tenants"] >= meta["capacity"]:
                     continue
                 load = meta["tenants"] / meta["capacity"]

@@ -219,15 +219,77 @@ class NativeConflictSet:
         r_arr = np.asarray(reads, np.int64).reshape(-1, 5)
         w_arr = np.asarray(writes, np.int64).reshape(-1, 5)
         statuses = np.empty(len(txns), np.uint8)
+        return self._call_resolve(bytes(blob), r_arr, len(reads), w_arr,
+                                  len(writes), rvs, len(txns),
+                                  commit_version, new_window_start,
+                                  statuses)
+
+    def _call_resolve(self, blob, r_arr, n_reads, w_arr, n_writes, rvs,
+                      n_txns, commit_version, new_window_start, statuses):
         i64p = ctypes.POINTER(ctypes.c_int64)
         self._lib.ccs_resolve_batch(
             self._ptr,
-            bytes(blob),
-            r_arr.ctypes.data_as(i64p), len(reads),
-            w_arr.ctypes.data_as(i64p), len(writes),
-            rvs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(txns),
+            blob,
+            r_arr.ctypes.data_as(i64p), n_reads,
+            w_arr.ctypes.data_as(i64p), n_writes,
+            rvs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n_txns,
             commit_version,
             new_window_start if new_window_start is not None else 0,
             statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
         return [_STATUS_MAP[s] for s in statuses.tolist()]
+
+    def resolve_flat(self, flat, commit_version, new_window_start=None):
+        """Resolve a columnar FlatTxnBatch (core/flatpack.py) with ZERO
+        per-key Python: the concatenated entry blobs double as the ABI
+        key blob. An entry is ``key ‖ \\x00-padding ‖ >I(len)``, so the
+        raw key is ``blob[off : off+len]`` — and a point's end span
+        ``k+\\x00`` is ``blob[off : off+len+1]``, the \\x00 supplied by
+        the entry's own padding (by the first length byte when
+        len == capacity, since capacity < 2^24). Offsets are pure
+        arithmetic; entries sort by txn with one stable argsort (the C
+        walk consumes rows strictly in txn order)."""
+        n = len(flat)
+        W = flat.num_limbs + 1
+        W4 = 4 * W
+        blob = flat.pr_blob + flat.pw_blob + flat.rr_blob + flat.rw_blob
+        base_pw = len(flat.pr_blob)
+        base_rr = base_pw + len(flat.pw_blob)
+        base_rw = base_rr + len(flat.rr_blob)
+
+        def lens_of(b):
+            if not b:
+                return np.zeros(0, np.int64)
+            return np.frombuffer(b, dtype=">u4").reshape(-1, W)[:, -1] \
+                .astype(np.int64)
+
+        def point_rows(b, base, counts):
+            t = np.repeat(np.arange(n), counts)
+            off = base + np.arange(len(t), dtype=np.int64) * W4
+            ln = lens_of(b)
+            return np.stack([t, off, ln, off, ln + 1], axis=1)
+
+        def range_rows(b, base, counts):
+            t = np.repeat(np.arange(n), counts)
+            ln = lens_of(b)  # interleaved lower/upper lengths
+            off = base + np.arange(2 * len(t), dtype=np.int64) * W4
+            return np.stack(
+                [t, off[0::2], ln[0::2], off[1::2], ln[1::2]], axis=1
+            )
+
+        def side(prows, rrows):
+            rows = np.concatenate([prows, rrows])
+            # stable: a txn's points stay ahead of its ranges
+            return np.ascontiguousarray(
+                rows[np.argsort(rows[:, 0], kind="stable")]
+            )
+
+        r_arr = side(point_rows(flat.pr_blob, 0, flat.prc),
+                     range_rows(flat.rr_blob, base_rr, flat.rrc))
+        w_arr = side(point_rows(flat.pw_blob, base_pw, flat.pwc),
+                     range_rows(flat.rw_blob, base_rw, flat.rwc))
+        rvs = np.ascontiguousarray(flat.rv.astype(np.uint64))
+        statuses = np.empty(n, np.uint8)
+        return self._call_resolve(blob, r_arr, len(r_arr), w_arr,
+                                  len(w_arr), rvs, n, commit_version,
+                                  new_window_start, statuses)

@@ -30,6 +30,7 @@ import jax
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.resolver.packing import BatchPacker
 from foundationdb_tpu.resolver.resolver import (
+    BACKLOG_B,
     Resolver,
     fast_params_of,
     params_from_knobs,
@@ -58,6 +59,9 @@ class MeshResolver(Resolver):
         self.backend = "tpu"
         self.base_version = base_version
         self.alive = True
+        self.wants_point_split = True
+        self.accepts_flat = True  # same packer machinery as Resolver
+        self.dispatch_wall_s = 0.0
         if mesh is None:
             n = max(1, min(n_lanes or 1, len(jax.devices())))
             if n_lanes is not None and n < n_lanes:
@@ -100,6 +104,10 @@ class MeshResolver(Resolver):
                 BatchPacker(self._fast_params), self._fast_kernel._step
             )
         self._scan_fns = {}
+        self._scan_pad_buckets = (
+            (2, 4, BACKLOG_B)
+            if jax.default_backend() == "cpu" else (BACKLOG_B,)
+        )
 
     def _make_scan_fn(self, use_fast):
         kernel = self._fast_kernel if use_fast else self._kernel

@@ -34,6 +34,14 @@ def bucket_of(limbs, bucket_bits):
     return (limbs[..., 0] >> np.uint32(32 - bucket_bits)).astype(np.int32)
 
 
+def _slots(c):
+    """counts[n] → (txn index, lane index) per flattened op."""
+    t_idx = np.repeat(np.arange(len(c)), c)
+    starts = np.cumsum(c) - c
+    i_idx = np.arange(len(t_idx)) - np.repeat(starts, c)
+    return t_idx, i_idx
+
+
 class BatchPacker:
     """Packs transactions for one resolver (arrival order preserved).
 
@@ -45,15 +53,224 @@ class BatchPacker:
         native path defers to it on overflow (return code 1).
     """
 
+    # staging sets kept alive per stacked shape before a slot is reused:
+    # jax may alias (zero-copy) host numpy arrays into device buffers on
+    # CPU backends, and the commit pipeline keeps up to
+    # commit_pipeline_depth groups in flight — a slot must outlive every
+    # dispatch that could still be reading it
+    STAGING_RING = 4
+
     def __init__(self, params: ResolverParams, use_native=True):
         self.params = params
         self.codec = KeyCodec(num_limbs=params.key_width - 1)
         self._native = None
         self._empty = None  # cached zero-txn pad batch (pack_empty)
+        self._flat_rings = {}  # B → list of reusable staging dicts
+        self._flat_ring_next = {}  # B → next slot index
+        self._zero_hash = None  # fnv of an all-zero key row (cached)
+        self.flat_reuse_hits = 0
+        self.flat_reuse_misses = 0
         if use_native and params.key_width - 1 <= 16:
             from foundationdb_tpu.native import load_packer
 
             self._native = load_packer()
+
+    # ── flat columnar path (core/flatpack.py FlatTxnBatch) ───────────
+    def flat_fits(self, flat):
+        """Whether pack_flat_group can serve this batch: matching limb
+        width and every txn's op counts inside the packed lanes (the
+        legacy path's _normalize spill/coalesce has no flat twin — the
+        rare overflowing batch decodes and rides legacy)."""
+        p = self.params
+        return (
+            flat.num_limbs == p.key_width - 1
+            and len(flat) <= p.txns
+            and flat.prc.max(initial=0) <= p.point_reads
+            and flat.pwc.max(initial=0) <= p.point_writes
+            and flat.rrc.max(initial=0) <= p.range_reads
+            and flat.rwc.max(initial=0) <= p.range_writes
+        )
+
+    def _flat_staging(self, B):
+        """A zeroed staging set of stacked (B, T, …) arrays from the
+        per-shape reuse ring. Reuse (a fill(0) instead of eleven fresh
+        allocations per group) is the hit the pack-stage counters
+        report."""
+        p = self.params
+        ring = self._flat_rings.get(B)
+        if ring is None:
+            ring = self._flat_rings[B] = []
+            self._flat_ring_next[B] = 0
+        zero_hash = self._zero_hash
+        if zero_hash is None:
+            zero_hash = self._zero_hash = fnv_hash_np(
+                np.zeros((1, self.params.key_width), np.uint32)
+            )[0]
+        if len(ring) < self.STAGING_RING:
+            self.flat_reuse_misses += 1
+            T, W = p.txns, p.key_width
+            bufs = {
+                "rv": np.zeros((B, T), np.uint32),
+                "txn_mask": np.zeros((B, T), np.bool_),
+                "pr_key": np.zeros((B, T, p.point_reads, W), np.uint32),
+                "pr_hash": np.full((B, T, p.point_reads), zero_hash,
+                                   np.uint32),
+                "pr_bucket": np.zeros((B, T, p.point_reads), np.int32),
+                "pr_mask": np.zeros((B, T, p.point_reads), np.bool_),
+                "pw_key": np.zeros((B, T, p.point_writes, W), np.uint32),
+                "pw_hash": np.full((B, T, p.point_writes), zero_hash,
+                                   np.uint32),
+                "pw_bucket": np.zeros((B, T, p.point_writes), np.int32),
+                "pw_mask": np.zeros((B, T, p.point_writes), np.bool_),
+                "rr_b": np.zeros((B, T, p.range_reads, W), np.uint32),
+                "rr_e": np.zeros((B, T, p.range_reads, W), np.uint32),
+                "rr_lo": np.zeros((B, T, p.range_reads), np.int32),
+                "rr_hi": np.zeros((B, T, p.range_reads), np.int32),
+                "rr_mask": np.zeros((B, T, p.range_reads), np.bool_),
+                "rw_b": np.zeros((B, T, p.range_writes, W), np.uint32),
+                "rw_e": np.zeros((B, T, p.range_writes, W), np.uint32),
+                "rw_lo": np.zeros((B, T, p.range_writes), np.int32),
+                "rw_hi": np.zeros((B, T, p.range_writes), np.int32),
+                "rw_mask": np.zeros((B, T, p.range_writes), np.bool_),
+                "cv": np.zeros(B, np.uint32),
+                "nws": np.zeros(B, np.uint32),
+            }
+            ring.append(bufs)
+            return bufs
+        i = self._flat_ring_next[B]
+        self._flat_ring_next[B] = (i + 1) % len(ring)
+        self.flat_reuse_hits += 1
+        bufs = ring[i]
+        for name, a in bufs.items():
+            if name in ("pr_hash", "pw_hash"):
+                a.fill(zero_hash)  # the hash of an all-zero key row
+            elif name not in ("cv", "nws"):  # fully overwritten below
+                a.fill(0)
+        return bufs
+
+    def pack_flat_group(self, flats, metas, base_version, B=None):
+        """Pack a whole backlog group of FlatTxnBatches into ONE stacked
+        ResolveBatch (leading dim ``B``, zero-padded past ``len(flats)``
+        like resolve_many's pack_empty pads) — bit-identical to packing
+        each batch with :meth:`pack` and ``np.stack``-ing, without a
+        single per-transaction Python step: blob bytes become limb rows
+        with one frombuffer per lane, slot indices come from cumsums,
+        and hashing/bucketing run once over the stacked arrays.
+
+        ``metas``: [(commit_version, new_window_start)] per flat batch;
+        pads inherit the last entry (matching the legacy pad template).
+        Callers must have checked :meth:`flat_fits` per batch.
+        """
+        from foundationdb_tpu.core import flatpack
+
+        p = self.params
+        nb = len(flats)
+        if B is None:
+            B = nb
+        bufs = self._flat_staging(B)
+        u32 = np.uint32
+        # group-GLOBAL scatter: one index build + one fancy-index store
+        # per lane for the whole backlog, however many batches it holds
+        # (per-batch loops were the next-largest pack cost after the
+        # dispatch itself). b_of/t_of map a global txn row to its
+        # (batch, txn-lane) slot; entry rows index through them.
+        if nb == 1:
+            f = flats[0]
+            n_txns = np.array([len(f)], dtype=np.int64)
+            rv_all = f.rv
+            cat = (
+                (f.prc, f.pwc, f.rrc, f.rwc),
+                (f.pr_blob, f.pw_blob, f.rr_blob, f.rw_blob),
+            )
+        else:
+            n_txns = np.fromiter(
+                (len(f) for f in flats), np.int64, count=nb
+            )
+            rv_all = np.concatenate([f.rv for f in flats])
+            cat = (
+                tuple(
+                    np.concatenate([getattr(f, c) for f in flats])
+                    for c in ("prc", "pwc", "rrc", "rwc")
+                ),
+                tuple(
+                    b"".join([getattr(f, c) for f in flats])
+                    for c in ("pr_blob", "pw_blob", "rr_blob", "rw_blob")
+                ),
+            )
+        (prc, pwc, rrc, rwc), (pr_blob, pw_blob, rr_blob, rw_blob) = cat
+        b_of = np.repeat(np.arange(nb), n_txns)
+        _, t_of = _slots(n_txns)
+        if len(rv_all):
+            bufs["rv"][b_of, t_of] = np.clip(
+                rv_all - base_version, 0, 0xFFFFFFFF
+            ).astype(u32)
+            bufs["txn_mask"][b_of, t_of] = True
+        L = p.key_width - 1
+        if len(pr_blob):
+            t, i = _slots(prc)
+            bufs["pr_key"][b_of[t], t_of[t], i] = flatpack.point_limbs(
+                pr_blob, L)
+            bufs["pr_mask"][b_of[t], t_of[t], i] = True
+        if len(pw_blob):
+            t, i = _slots(pwc)
+            bufs["pw_key"][b_of[t], t_of[t], i] = flatpack.point_limbs(
+                pw_blob, L)
+            bufs["pw_mask"][b_of[t], t_of[t], i] = True
+        if len(rr_blob):
+            t, i = _slots(rrc)
+            lo, hi = flatpack.range_limbs(rr_blob, L)
+            bufs["rr_b"][b_of[t], t_of[t], i] = lo
+            bufs["rr_e"][b_of[t], t_of[t], i] = hi
+            bufs["rr_mask"][b_of[t], t_of[t], i] = True
+        if len(rw_blob):
+            t, i = _slots(rwc)
+            lo, hi = flatpack.range_limbs(rw_blob, L)
+            bufs["rw_b"][b_of[t], t_of[t], i] = lo
+            bufs["rw_e"][b_of[t], t_of[t], i] = hi
+            bufs["rw_mask"][b_of[t], t_of[t], i] = True
+        for b, (cv, ws) in enumerate(metas):
+            bufs["cv"][b] = u32(cv - base_version)
+            bufs["nws"][b] = u32(max(0, ws - base_version))
+        if nb < B:  # pads share the last batch's version scalars
+            bufs["cv"][nb:] = bufs["cv"][nb - 1] if nb else 0
+            bufs["nws"][nb:] = bufs["nws"][nb - 1] if nb else 0
+        # hash/bucket only the LIVE batches: pad rows already hold the
+        # all-zero-key constants (zero_hash / bucket 0) from staging
+        bufs["pr_hash"][:nb] = fnv_hash_np(bufs["pr_key"][:nb])
+        bufs["pr_bucket"][:nb] = bucket_of(bufs["pr_key"][:nb],
+                                           p.bucket_bits)
+        bufs["pw_hash"][:nb] = fnv_hash_np(bufs["pw_key"][:nb])
+        bufs["pw_bucket"][:nb] = bucket_of(bufs["pw_key"][:nb],
+                                           p.bucket_bits)
+        bufs["rr_lo"][:nb] = bucket_of(bufs["rr_b"][:nb], p.bucket_bits)
+        bufs["rr_hi"][:nb] = bucket_of(bufs["rr_e"][:nb], p.bucket_bits)
+        bufs["rw_lo"][:nb] = bucket_of(bufs["rw_b"][:nb], p.bucket_bits)
+        bufs["rw_hi"][:nb] = bucket_of(bufs["rw_e"][:nb], p.bucket_bits)
+        return ResolveBatch(
+            rv=bufs["rv"], txn_mask=bufs["txn_mask"],
+            pr_hash=bufs["pr_hash"], pr_key=bufs["pr_key"],
+            pr_bucket=bufs["pr_bucket"], pr_mask=bufs["pr_mask"],
+            pw_hash=bufs["pw_hash"], pw_key=bufs["pw_key"],
+            pw_bucket=bufs["pw_bucket"], pw_mask=bufs["pw_mask"],
+            rr_b=bufs["rr_b"], rr_e=bufs["rr_e"],
+            rr_lo=bufs["rr_lo"], rr_hi=bufs["rr_hi"],
+            rr_mask=bufs["rr_mask"],
+            rw_b=bufs["rw_b"], rw_e=bufs["rw_e"],
+            rw_lo=bufs["rw_lo"], rw_hi=bufs["rw_hi"],
+            rw_mask=bufs["rw_mask"],
+            cv=bufs["cv"], new_window_start=bufs["nws"],
+        )
+
+    def pack_flat(self, flat, base_version, commit_version,
+                  new_window_start):
+        """Single-batch flat pack: one group slot, leading dim dropped —
+        shape-compatible with :meth:`pack`'s output (the sync
+        commit_batch path)."""
+        stacked = self.pack_flat_group(
+            [flat], [(commit_version, new_window_start)], base_version,
+            B=1,
+        )
+        return ResolveBatch(*(a[0] for a in stacked))
 
     def pack_empty(self, base_version, commit_version, new_window_start):
         """A zero-txn pad batch (resolve_many's fixed-width padding).
@@ -245,17 +462,10 @@ class BatchPacker:
             txns = [self._normalize(t) for t in txns]
             prc, pwc, rrc, rwc = counts()
 
-        def slots(c):
-            """counts[n] → (txn index, lane index) per flattened op."""
-            t_idx = np.repeat(np.arange(n), c)
-            starts = np.cumsum(c) - c
-            i_idx = np.arange(len(t_idx)) - np.repeat(starts, c)
-            return t_idx, i_idx
-
-        pr_t, pr_i = slots(prc)
-        pw_t, pw_i = slots(pwc)
-        rr_t, rr_i = slots(rrc)
-        rw_t, rw_i = slots(rwc)
+        pr_t, pr_i = _slots(prc)
+        pw_t, pw_i = _slots(pwc)
+        rr_t, rr_i = _slots(rrc)
+        rw_t, rw_i = _slots(rwc)
         # single-pass key gathers; C-speed zip(*) unzips the range pairs
         pr_k = [k for x in txns for k in x.point_reads]
         pw_k = [k for x in txns for k in x.point_writes]

@@ -14,6 +14,7 @@ and T statuses out). ``"cpu"`` runs the exact host ConflictSet
 import jax
 import numpy as np
 
+from foundationdb_tpu.core.flatpack import FlatTxnBatch
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.ops import conflict as ck
 from foundationdb_tpu.resolver.packing import BatchPacker
@@ -122,6 +123,11 @@ class Resolver:
         self.backend = knobs.resolver_backend
         self.base_version = base_version
         self.alive = True
+        # wall seconds spent inside resolve_many's device dispatch (the
+        # scan call; for host backends, the eager resolve) — the batcher
+        # subtracts this from its stage-A+B timer so stage_pack_ms
+        # measures HOST PACKING and stage_dispatch_ms the dispatch
+        self.dispatch_wall_s = 0.0
         # The device kernel has dedicated point LANES, and the native
         # conflict set packs a split-out point key once with its end
         # span aliasing the same blob bytes — both want the proxy's
@@ -129,6 +135,11 @@ class Resolver:
         # as the tiny range it is, so the proxy skips the split there
         # (it was the hottest line of the host commit pipeline).
         self.wants_point_split = self.backend in ("tpu", "native")
+        # the flat columnar commit path (core/flatpack.py): the device
+        # packer consumes limb blobs directly, and the native set reads
+        # raw key bytes out of the same blobs; the pure-python cpu
+        # backend sticks to byte-pair ranges
+        self.accepts_flat = self.backend in ("tpu", "native")
         if self.backend == "tpu":
             pallas = getattr(knobs, "pallas_ring", "auto")
             use_pallas = pallas == "on" or (
@@ -163,6 +174,16 @@ class Resolver:
             # (variant, padded batch count) — each (fast, B) pair is one
             # XLA compilation
             self._scan_fns = {}
+            # pad-width buckets: a backlog dispatch pads to the smallest
+            # bucket that fits. Pad batches are pure wasted kernel
+            # compute, so on an interpreter-hosted (cpu) device — where
+            # a scan compile is cheap — small backlogs pay a fraction of
+            # the fixed B=8 dispatch cost; on a real/tunneled TPU a scan
+            # compile costs tens of seconds, so one bucket only.
+            self._scan_pad_buckets = (
+                (2, 4, BACKLOG_B)
+                if jax.default_backend() == "cpu" else (BACKLOG_B,)
+            )
         elif self.backend == "cpu":
             self.cset = CpuConflictSet()
             self.cset.window_start = base_version
@@ -194,10 +215,21 @@ class Resolver:
         params = self._fast_params if use_fast else self.params
         return ck.make_resolve_scan_fn(params)
 
+    def _pad_bucket(self, nb):
+        """Smallest scan pad width that fits ``nb`` batches."""
+        for b in self._scan_pad_buckets:
+            if nb <= b:
+                return b
+        return BACKLOG_B
+
     def resolve(self, txns, commit_version, new_window_start):
-        """txns: list[TxnRequest] in arrival order → list of statuses."""
+        """txns: list[TxnRequest] (or a FlatTxnBatch — the columnar
+        commit path) in arrival order → list of statuses."""
         if not self.alive:
             raise ResolverDown()
+        if isinstance(txns, FlatTxnBatch):
+            return self._resolve_flat(txns, commit_version,
+                                      new_window_start)
         if self.backend in ("cpu", "native"):
             return self.cset.resolve(txns, commit_version, new_window_start)
         self._maybe_rebase(commit_version)
@@ -221,34 +253,9 @@ class Resolver:
             batch = packer.pack(
                 [t for _, t in chunk], self.base_version, commit_version, new_window_start
             )
-            try:
-                status, _accepted, self.state = resolve_fn(self.state, batch)
-                # materialize INSIDE the try: dispatch is async, so a
-                # kernel that compiles but faults at runtime only raises
-                # here — outside, the fallback would never engage and
-                # self.state would hold poisoned arrays
-                out = np.asarray(status)[: len(chunk)].tolist()
-            except Exception as e:
-                if (not self.params.use_pallas
-                        or resolve_fn is not self._resolve
-                        or not _is_pallas_fallback_error(e)):
-                    raise  # pallas only runs in the full variant; non-JAX
-                    # errors (packer bugs …) must not wipe device history
-                # The Pallas ring kernel failed to build/run on this
-                # backend: fall back to the jnp lanes for the life of the
-                # resolver rather than failing every commit. The device
-                # history may be donated/poisoned by the failed dispatch,
-                # so restart fenced exactly like a recruited resolver —
-                # this batch (and any read version from before the fence)
-                # retries TOO_OLD with fresh reads.
-                from foundationdb_tpu.utils.trace import TraceEvent
-
-                TraceEvent("PallasRingFallback", severity=30).detail(
-                    fenced_at=commit_version).log()
-                self.params = self.params._replace(use_pallas=False)
-                self._resolve = ck.make_resolve_fn(self.params)
-                self.state = ck.init_state(self.params)
-                self.base_version = commit_version
+            out = self._step_kernel(resolve_fn, batch, len(chunk),
+                                    commit_version)
+            if out is None:  # pallas fallback engaged: fenced restart
                 for j in range(len(statuses)):
                     if statuses[j] is None:
                         statuses[j] = TOO_OLD
@@ -256,6 +263,71 @@ class Resolver:
             for (i, _), s in zip(chunk, out):
                 statuses[i] = s
         return statuses
+
+    def _step_kernel(self, resolve_fn, batch, n, commit_version):
+        """One threaded kernel step → statuses[:n], or None when the
+        Pallas fallback engaged (the resolver restarted fenced and the
+        caller must answer TOO_OLD)."""
+        try:
+            status, _accepted, self.state = resolve_fn(self.state, batch)
+            # materialize INSIDE the try: dispatch is async, so a
+            # kernel that compiles but faults at runtime only raises
+            # here — outside, the fallback would never engage and
+            # self.state would hold poisoned arrays
+            return np.asarray(status)[:n].tolist()
+        except Exception as e:
+            if (not self.params.use_pallas
+                    or resolve_fn is not self._resolve
+                    or not _is_pallas_fallback_error(e)):
+                raise  # pallas only runs in the full variant; non-JAX
+                # errors (packer bugs …) must not wipe device history
+            # The Pallas ring kernel failed to build/run on this
+            # backend: fall back to the jnp lanes for the life of the
+            # resolver rather than failing every commit. The device
+            # history may be donated/poisoned by the failed dispatch,
+            # so restart fenced exactly like a recruited resolver —
+            # this batch (and any read version from before the fence)
+            # retries TOO_OLD with fresh reads.
+            from foundationdb_tpu.utils.trace import TraceEvent
+
+            TraceEvent("PallasRingFallback", severity=30).detail(
+                fenced_at=commit_version).log()
+            self.params = self.params._replace(use_pallas=False)
+            self._resolve = ck.make_resolve_fn(self.params)
+            self.state = ck.init_state(self.params)
+            self.base_version = commit_version
+            return None
+
+    def _resolve_flat(self, flat, commit_version, new_window_start):
+        """Resolve one columnar batch. The native set reads raw key
+        bytes straight out of the blobs; the tpu path packs limb rows
+        into the staging ring. Anything the flat lane can't serve —
+        width mismatch, lane overflow, a too-old read version that the
+        host must pre-filter — decodes to TxnRequests and rides the
+        legacy path (rare by construction)."""
+        if self.backend == "native":
+            return self.cset.resolve_flat(flat, commit_version,
+                                          new_window_start)
+        if self.backend == "cpu":
+            return self.cset.resolve(flat.to_txn_requests(),
+                                     commit_version, new_window_start)
+        self._maybe_rebase(commit_version)
+        if not self.packer.flat_fits(flat) or (
+            len(flat) and int(flat.rv.min()) < self.base_version
+        ):
+            return self.resolve(flat.to_txn_requests(), commit_version,
+                                new_window_start)
+        use_fast = self._pick_fast_flat([flat])
+        packer, resolve_fn = self._fast if use_fast else (
+            self.packer, self._resolve
+        )
+        batch = packer.pack_flat(flat, self.base_version, commit_version,
+                                 new_window_start)
+        out = self._step_kernel(resolve_fn, batch, len(flat),
+                                commit_version)
+        if out is None:
+            return [TOO_OLD] * len(flat)
+        return out
 
     def _pick_fast(self, txns):
         """Whether the point-specialized variant may serve these txns
@@ -274,6 +346,22 @@ class Resolver:
                 break
             if t.range_reads or len(t.point_reads) > pr_cap:
                 point_only = False  # needs range lanes this batch
+        return point_only and not self._range_history
+
+    def _pick_fast_flat(self, flats):
+        """_pick_fast's columnar twin — count maxima instead of per-txn
+        walks. Callers route lane-overflowing batches to the legacy
+        path first, so only range presence matters here."""
+        if self._fast is None:
+            return False
+        point_only = True
+        for f in flats:
+            if f.rwc.max(initial=0) > 0:
+                self._range_history = True
+                point_only = False
+                break
+            if f.rrc.max(initial=0) > 0:
+                point_only = False
         return point_only and not self._range_history
 
     def resolve_many(self, batches, lazy=False):
@@ -304,9 +392,12 @@ class Resolver:
                 or any(len(t) > self.params.txns for t, _, _ in batches)):
             # host backends / degenerate backlogs resolve eagerly — the
             # handle is already settled
-            return ResolveHandle(
-                result=[self.resolve(t, cv, ws) for t, cv, ws in batches]
-            )
+            import time as _time
+
+            t0 = _time.perf_counter()
+            result = [self.resolve(t, cv, ws) for t, cv, ws in batches]
+            self.dispatch_wall_s += _time.perf_counter() - t0
+            return ResolveHandle(result=result)
         if len(batches) > BACKLOG_B:
             # Oversized backlog — the overload case this path exists for.
             # Chunk into BACKLOG_B-wide scans (each one dispatch) instead
@@ -322,6 +413,16 @@ class Resolver:
         if not self.alive:
             raise ResolverDown()
         self._maybe_rebase(batches[-1][1])
+        if all(isinstance(t, FlatTxnBatch) for t, _, _ in batches):
+            handle = self._dispatch_flat(batches)
+            if handle is not None:
+                return handle
+        # a mixed or flat-ineligible backlog decodes to the legacy path
+        batches = [
+            (t.to_txn_requests() if isinstance(t, FlatTxnBatch) else t,
+             cv, ws)
+            for t, cv, ws in batches
+        ]
         per_batch = []
         all_live = []
         for txns, cv, ws in batches:
@@ -344,7 +445,9 @@ class Resolver:
         # on a tunneled chip, so every backlog size must share the same
         # compilation (empty padding batches cost ~ms of device time —
         # noise against the round trip this dispatch saves; pads come
-        # from the packer's cached template, not a fresh pack).
+        # from the packer's cached template, not a fresh pack). The
+        # flat path buckets instead (_dispatch_flat) — variable padded
+        # shapes are part of its staging design.
         B = BACKLOG_B
         last_cv, last_ws = batches[-1][1], batches[-1][2]
         if len(packed) < B:
@@ -356,7 +459,11 @@ class Resolver:
             scan_fn = self._make_scan_fn(use_fast)
             self._scan_fns[key] = scan_fn
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *packed)
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.state, st = scan_fn(self.state, stacked)
+        self.dispatch_wall_s += _time.perf_counter() - t0
 
         def materialize():
             arr = np.asarray(st)  # the ONE host sync for the backlog
@@ -367,6 +474,44 @@ class Resolver:
                     statuses[i] = s
                 out.append(statuses)
             return out
+
+        return ResolveHandle(materialize=materialize)
+
+    def _dispatch_flat(self, batches):
+        """The columnar backlog dispatch: the whole group packs into one
+        stacked staging set (no per-batch ResolveBatch objects, no
+        np.stack copy) and rides the same cached scan. None when any
+        batch needs the legacy path (lane overflow, width mismatch, a
+        too-old read version the host must pre-filter)."""
+        flats = [t for t, _, _ in batches]
+        for f in flats:
+            if not self.packer.flat_fits(f) or (
+                len(f) and int(f.rv.min()) < self.base_version
+            ):
+                return None
+        use_fast = self._pick_fast_flat(flats)
+        packer = self._fast[0] if use_fast else self.packer
+        B = self._pad_bucket(len(flats))
+        stacked = packer.pack_flat_group(
+            flats, [(cv, ws) for _, cv, ws in batches],
+            self.base_version, B=B,
+        )
+        key = (use_fast, B)
+        scan_fn = self._scan_fns.get(key)
+        if scan_fn is None:
+            scan_fn = self._make_scan_fn(use_fast)
+            self._scan_fns[key] = scan_fn
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self.state, st = scan_fn(self.state, stacked)
+        self.dispatch_wall_s += _time.perf_counter() - t0
+
+        def materialize():
+            arr = np.asarray(st)  # the ONE host sync for the backlog
+            return [
+                arr[b][: len(f)].tolist() for b, f in enumerate(flats)
+            ]
 
         return ResolveHandle(materialize=materialize)
 

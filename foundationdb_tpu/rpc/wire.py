@@ -15,10 +15,11 @@ import struct
 
 from foundationdb_tpu.core.commit import CommitRequest
 from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.core.flatpack import FlatConflicts
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.core.mutations import Mutation, Op
 
-PROTOCOL_VERSION = 3  # v3: CommitRequest carries idempotency_id
+PROTOCOL_VERSION = 4  # v4: columnar commit frame (flat conflict blobs)
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -84,6 +85,20 @@ def _enc(buf, v):
         buf.append(b"T" if v.or_equal else b"F")
         buf.append(struct.pack(">i", v.offset))
     elif t is CommitRequest:
+        if v.flat_conflicts is not None:
+            # the columnar frame: conflict ranges travel ONLY as the
+            # client's pre-encoded limb blobs — the server-side proxy
+            # consumes them without re-parsing a single key, and the
+            # byte-pair lists reconstruct lazily (CommitRequest
+            # properties) on the rare paths that still want them
+            buf.append(b"Q")
+            _enc(buf, v.read_version)
+            _enc(buf, list(v.mutations))
+            _enc(buf, v.flat_conflicts)
+            buf.append(b"T" if v.report_conflicting_keys else b"F")
+            buf.append(b"T" if v.lock_aware else b"F")
+            _enc(buf, v.idempotency_id)
+            return
         buf.append(b"R")
         _enc(buf, v.read_version)
         _enc(buf, list(v.mutations))
@@ -92,6 +107,16 @@ def _enc(buf, v):
         buf.append(b"T" if v.report_conflicting_keys else b"F")
         buf.append(b"T" if v.lock_aware else b"F")
         _enc(buf, v.idempotency_id)
+    elif t is FlatConflicts:
+        buf.append(b"C")
+        buf.append(struct.pack(
+            ">BIIII", v.num_limbs, v.read_points, v.read_ranges,
+            v.write_points, v.write_ranges,
+        ))
+        _pack_len(buf, v.read_point_blob)
+        _pack_len(buf, v.read_range_blob)
+        _pack_len(buf, v.write_point_blob)
+        _pack_len(buf, v.write_range_blob)
     elif isinstance(v, FDBError):
         buf.append(b"e")
         buf.append(struct.pack(">I", v.code))
@@ -173,6 +198,23 @@ def _dec(r: _Reader):
         idmp = _dec(r)
         return CommitRequest(rv, muts, rcr, wcr, report, lock_aware,
                              idempotency_id=idmp)
+    if tag == b"Q":
+        rv = _dec(r)
+        muts = _dec(r)
+        flat = _dec(r)
+        report = r.take(1) == b"T"
+        lock_aware = r.take(1) == b"T"
+        idmp = _dec(r)
+        # range lists None: reconstructed lazily from the blobs only if
+        # a legacy consumer asks (CommitRequest._from_flat)
+        return CommitRequest(rv, muts, None, None, report, lock_aware,
+                             idempotency_id=idmp, flat_conflicts=flat)
+    if tag == b"C":
+        num_limbs, rp, rr, wp, wr = struct.unpack(">BIIII", r.take(17))
+        return FlatConflicts(
+            num_limbs, rp, r.take_len(), rr, r.take_len(),
+            wp, r.take_len(), wr, r.take_len(),
+        )
     if tag == b"e":
         (code,) = struct.unpack(">I", r.take(4))
         e = FDBError(code)

@@ -156,8 +156,15 @@ class ProxyFleet:
         out = {}
         for k in sums[0]:
             vals = [s[k] for s in sums]
-            out[k] = (max(vals) if k == "pipeline_depth"
-                      else round(sum(vals) / len(vals), 3))
+            if k == "pipeline_depth":
+                out[k] = max(vals)
+            elif k == "pack_path":
+                # the members' dominant path; "mixed" when they differ
+                out[k] = vals[0] if len(set(vals)) == 1 else "mixed"
+            elif k in ("pack_flat_batches", "pack_legacy_batches"):
+                out[k] = sum(vals)
+            else:
+                out[k] = round(sum(vals) / len(vals), 3)
         return out
 
     def __len__(self):

@@ -104,6 +104,12 @@ class CommitProxy:
         self.change_feeds = change_feeds  # ChangeFeedRegistry | None
         self.commit_count = 0
         self.conflict_count = 0
+        # commit pack-path observability (ISSUE 3): how many request
+        # batches packed columnar vs legacy, and the flat bytes moved —
+        # stage_summary()/bench lines report these per run
+        self.pack_flat_batches = 0
+        self.pack_legacy_batches = 0
+        self.pack_bytes_total = 0
         # Concurrent client threads may drive the synchronous proxy
         # directly (no batching wrapper): the pipeline mutates shared
         # state (donated resolver buffers, tlog order, storage overlay),
@@ -736,6 +742,25 @@ class CommitProxy:
                     self.log_gate.advance(group.last_cv)
                 group.apply_s = _time.perf_counter() - t1
 
+    def _try_build_flat(self, requests):
+        """The columnar batch build (core/flatpack.py): when the knob,
+        the resolver, and every request agree, concatenate the clients'
+        pre-encoded limb blobs into one FlatTxnBatch — no TxnRequest
+        objects, no per-range split, no per-key re-parse. None routes
+        the batch to the legacy build (mixed/legacy requests, cpu or
+        sharded resolvers, over-capacity idempotency keys); both builds
+        pack bit-identically (tests/test_packing_flat.py)."""
+        res = self.resolvers
+        if (getattr(self.knobs, "commit_pack_path", "legacy") != "flat"
+                or len(res) != 1
+                or not getattr(res[0], "accepts_flat", False)):
+            return None
+        from foundationdb_tpu.core import flatpack
+
+        return flatpack.build_flat_batch(
+            requests, self.knobs.key_limbs, self._idmp_point
+        )
+
     def _build_txns(self, requests):
         rv_assigned = None
         n_lazy = 0
@@ -754,6 +779,12 @@ class CommitProxy:
             # they bypassed the GRV's admission sampling: feed the
             # busy-tag base or tagged share reads inflated
             self.ratekeeper.note_untagged_admissions(n_lazy)
+        flat = self._try_build_flat(requests)
+        if flat is not None:
+            self.pack_flat_batches += 1
+            self.pack_bytes_total += flat.pack_bytes
+            return flat
+        self.pack_legacy_batches += 1
         if not all(getattr(r_, "wants_point_split", True)
                    for r_ in self.resolvers):
             # host backends: a point IS its tiny range — hand the

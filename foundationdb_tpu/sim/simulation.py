@@ -125,6 +125,15 @@ class Simulation:
         self.machine_reboots = 0
         self.cluster_kwargs = dict(cluster_kwargs)
         self.cluster_kwargs.setdefault("resolver_backend", "cpu")
+        # alternate the commit pack path by seed (NOT an rng draw — that
+        # would shift every schedule of existing seeds): half the sim
+        # population commits through the flat columnar encode/wire path,
+        # half through legacy, so both stay under fault injection. The
+        # cpu sim backend resolves legacy either way; the flat half still
+        # exercises client encode + the proxy's fallback decision.
+        self.cluster_kwargs.setdefault(
+            "commit_pack_path", "flat" if seed % 2 == 0 else "legacy"
+        )
         self.datadir = datadir or tempfile.mkdtemp(prefix="fdbtpu-sim-")
         os.makedirs(self.datadir, exist_ok=True)
         self.recoveries = 0

@@ -123,6 +123,16 @@ def main(argv=None):
     args = p.parse_args(argv)
     secret = args.auth_secret or os.environ.get("FDB_TPU_AUTH_SECRET")
 
+    # Read-RPC latency under commit load: CPython schedules a waiting
+    # thread only every sys.getswitchinterval() (default 5ms), so a
+    # read RPC landing while a commit batch holds this process's GIL
+    # waits out the slice. Measured on the multiproc bench harness:
+    # 223us/read idle, 5.6ms under write load at the default interval,
+    # 4.2ms at 0.5ms — the residue is GIL convoy on both ends of the
+    # synchronous read (see bench.py e2e_multiproc_bottleneck). Commit
+    # throughput is unaffected (its hot sections are numpy/C calls).
+    sys.setswitchinterval(0.0005)
+
     host, _, port = args.listen.rpartition(":")
     if secret is None and host not in ("", "127.0.0.1", "localhost",
                                        "::1", "[::1]"):

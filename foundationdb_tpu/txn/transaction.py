@@ -8,6 +8,7 @@ atomic helpers, snapshot view, watch, on_error retry protocol.
 
 import time
 
+from foundationdb_tpu.core import flatpack
 from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.keys import (
     MAX_KEY_SIZE,
@@ -534,14 +535,28 @@ class Transaction:
             rv = None
         else:
             rv = self.get_read_version()
+        rcr = _coalesce(self._read_conflicts)
+        wcr = _coalesce(self._write_conflicts)
+        # columnar fast path (core/flatpack.py): pre-encode the conflict
+        # ranges into limb-entry blobs HERE, on the client, so neither
+        # the wire decode nor the proxy's batch build ever re-parses a
+        # key. Pure bytes ops — the limb encoding of an in-capacity key
+        # is its zero-padded bytes plus a length word. None (a key past
+        # limb capacity) simply leaves the request on the legacy path.
+        flat = None
+        if getattr(self._knobs, "commit_pack_path", "legacy") == "flat":
+            flat = flatpack.encode_conflicts(
+                rcr, wcr, self._knobs.key_limbs
+            )
         return CommitRequest(
             read_version=rv,
             mutations=list(self._mutation_log),
-            read_conflict_ranges=_coalesce(self._read_conflicts),
-            write_conflict_ranges=_coalesce(self._write_conflicts),
+            read_conflict_ranges=rcr,
+            write_conflict_ranges=wcr,
             report_conflicting_keys=self._report_conflicting_keys,
             lock_aware=self._lock_aware,
             idempotency_id=idmp,
+            flat_conflicts=flat,
         )
 
     def _ensure_idempotency_id(self):

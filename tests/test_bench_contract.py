@@ -22,8 +22,12 @@ def test_compact_summary_is_small_and_headline_last():
         "e2e_committed_txns_per_sec": 9400.0, "e2e_proxies": 2,
         "e2e_conflict_rate": 0.01,
         # commit-pipeline stage timings (server/batcher.py StageStats)
-        "stage_pack_ms": 1.2, "stage_resolve_ms": 3.4,
+        "stage_pack_ms": 1.2, "stage_dispatch_ms": 0.6,
+        "stage_resolve_ms": 3.4,
         "stage_apply_ms": 2.1, "pipeline_depth_effective": 1.8,
+        # flat columnar pack-path observability (ISSUE 3)
+        "pack_path": "flat", "pack_bytes": 6052,
+        "pack_reuse_rate": 0.99,
         # static-analysis debt (analysis/flowlint.py): 0 must still ride
         "flowlint_findings": 0,
     }
@@ -45,9 +49,15 @@ def test_compact_summary_is_small_and_headline_last():
     # per-stage pipeline timings ride the summary so BENCH_* trajectories
     # show which commit stage is critical-path
     assert line["stage_pack_ms"] == 1.2
+    assert line["stage_dispatch_ms"] == 0.6
     assert line["stage_resolve_ms"] == 3.4
     assert line["stage_apply_ms"] == 2.1
     assert line["pipeline_depth_effective"] == 1.8
+    # the pack path and its byte/reuse gauges ride the summary so the
+    # flat-vs-legacy reduction is visible per run
+    assert line["pack_path"] == "flat"
+    assert line["pack_bytes"] == 6052
+    assert line["pack_reuse_rate"] == 0.99
     # lint debt rides the summary — and a clean tree's 0 is not dropped
     assert line["flowlint_findings"] == 0
     assert line["configs"]["range"] == 390000.0
@@ -100,8 +110,25 @@ def test_e2e_line_folds_proxies_and_platform():
                            n_proxies=2)
     for key in ("e2e_proxies", "platform", "e2e_backend",
                 "e2e_conflict_rate", "e2e_backlog_target",
-                "stage_pack_ms", "stage_resolve_ms", "stage_apply_ms",
-                "pipeline_depth", "pipeline_depth_effective"):
+                "stage_pack_ms", "stage_dispatch_ms",
+                "stage_resolve_ms", "stage_apply_ms",
+                "pipeline_depth", "pipeline_depth_effective",
+                "pack_path", "pack_bytes", "pack_reuse_rate"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
     assert fields["pipeline_depth"] >= 1
+    # the cpu backend never flattens: the knob's fallback is visible
+    assert fields["pack_path"] == "legacy"
+
+
+def test_pack_smoke_contract():
+    """BENCH_MODE=pack_smoke emits the pack-path fields the trajectory
+    tracks, and the flat path actually beats legacy on this machine."""
+    out = bench.run_pack_smoke(cpu=True)
+    for key in ("pack_path", "stage_pack_ms", "stage_pack_ms_legacy",
+                "pack_bytes", "pack_reuse_rate", "value",
+                "vs_baseline"):
+        assert key in out, key
+    assert out["pack_path"] == "flat"
+    assert out["stage_pack_ms"] > 0
+    assert out["value"] > 1.0, out  # flat must not be slower

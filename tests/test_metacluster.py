@@ -226,6 +226,95 @@ def test_register_failure_rolls_back_cleanly(meta):
     assert mc.create_tenant(b"still-works") in (b"dc1", b"dc2")
 
 
+def test_register_data_cluster_resumes_after_crash(meta, monkeypatch):
+    """Crash in the two-transaction registration window (registry row
+    committed, data-side mark not yet): the row persists as
+    'registering', create_tenant refuses to assign onto it, and
+    re-calling register_data_cluster RESUMES — no 2161, no operator
+    remove_data_cluster needed (ADVICE r5 low)."""
+    import foundationdb_tpu.layers.metacluster as mcmod
+
+    mc, d1, d2 = meta
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        db = c.database()
+
+        class Boom(Exception):
+            pass
+
+        # crash between the registry-row commit and the data-side mark:
+        # the first transaction the data db runs AFTER the registry row
+        # exists (list_tenants' pre-check runs before it) dies
+        real_run = type(db).run
+        state = {"armed": True}
+
+        def crashing_run(self, fn):
+            if self is db and state["armed"] \
+                    and b"dc3" in mc.list_data_clusters():
+                state["armed"] = False
+                raise Boom()
+            return real_run(self, fn)
+
+        monkeypatch.setattr(type(db), "run", crashing_run)
+        with pytest.raises(Boom):
+            mc.register_data_cluster(b"dc3", db, capacity=2)
+        monkeypatch.setattr(type(db), "run", real_run)
+        # the orphaned row is visibly mid-registration, not assignable
+        row = mc.list_data_clusters()[b"dc3"]
+        assert row["state"] == "registering"
+        placed = mc.create_tenant(b"not-on-dc3")
+        assert placed in (b"dc1", b"dc2")
+        # re-registration RESUMES instead of failing 2161
+        mc.register_data_cluster(b"dc3", db, capacity=3)
+        row = mc.list_data_clusters()[b"dc3"]
+        assert row["state"] == "ready" and row["capacity"] == 3
+        # the resumed cluster is fully joined: marked + assignable
+        for i in range(5):
+            mc.create_tenant(b"fill%d" % i)
+        assert mc.list_data_clusters()[b"dc3"]["tenants"] > 0
+    finally:
+        c.close()
+
+
+def test_register_crash_after_mark_resumes(meta, monkeypatch):
+    """Crash AFTER the data-side mark but before the ready flip: the
+    retry sees its own mark on the data cluster and completes."""
+    import json
+
+    import foundationdb_tpu.layers.metacluster as mcmod
+
+    mc, d1, d2 = meta
+    c = Cluster(resolver_backend="cpu", **TEST_KNOBS)
+    try:
+        db = c.database()
+
+        class Boom(Exception):
+            pass
+
+        real_run = type(mc.db).run
+        calls = {"n": 0}
+
+        def crashing_run(self, fn):
+            if self is mc.db:
+                calls["n"] += 1
+                if calls["n"] == 2:  # the ready-flip transaction
+                    raise Boom()
+            return real_run(self, fn)
+
+        monkeypatch.setattr(type(mc.db), "run", crashing_run)
+        with pytest.raises(Boom):
+            mc.register_data_cluster(b"dc4", db, capacity=2)
+        monkeypatch.setattr(type(mc.db), "run", real_run)
+        assert mc.list_data_clusters()[b"dc4"]["state"] == "registering"
+        mc.register_data_cluster(b"dc4", db, capacity=2)  # resumes
+        assert mc.list_data_clusters()[b"dc4"]["state"] == "ready"
+        reg = json.loads(db.run(
+            lambda tr: tr.get(mcmod.REGISTRATION_KEY)))
+        assert reg == {"role": "data", "name": "dc4"}
+    finally:
+        c.close()
+
+
 def test_create_tenant_resumes_registering_state(meta, monkeypatch):
     """Crash between the management assignment and the data-side
     create: the assignment stays 'registering' (open_tenant refuses it

@@ -139,7 +139,11 @@ def test_native_backend_receives_point_split():
     from foundationdb_tpu.server.cluster import Cluster
     from tests.conftest import TEST_KNOBS
 
-    c = Cluster(resolver_backend="native", **TEST_KNOBS)
+    # commit_pack_path="legacy": this test exercises the LEGACY
+    # TxnRequest route into the native set (the flat columnar route has
+    # its own point-lane coverage in tests/test_packing_flat.py)
+    c = Cluster(resolver_backend="native", commit_pack_path="legacy",
+                **TEST_KNOBS)
     try:
         assert c.resolvers[0].wants_point_split
         seen = []
