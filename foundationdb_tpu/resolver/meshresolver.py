@@ -59,6 +59,7 @@ class MeshResolver(Resolver):
         self.backend = "tpu"
         self.base_version = base_version
         self.alive = True
+        self._init_metrics()
         self.wants_point_split = True
         self.accepts_flat = True  # same packer machinery as Resolver
         self.dispatch_wall_s = 0.0
@@ -116,5 +117,8 @@ class MeshResolver(Resolver):
     def respawn(self, base_version):
         """Recruitment: a fresh fleet on the same mesh, fenced (the
         sharded history died with this instance)."""
-        return MeshResolver(self.knobs, base_version=base_version,
-                            mesh=self.mesh)
+        new = MeshResolver(self.knobs, base_version=base_version,
+                           mesh=self.mesh)
+        new._init_metrics(self.metrics)
+        new._m_respawns.inc()
+        return new

@@ -19,6 +19,7 @@ from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.ops import conflict as ck
 from foundationdb_tpu.resolver.packing import BatchPacker
 from foundationdb_tpu.resolver.skiplist import CpuConflictSet
+from foundationdb_tpu.utils import metrics as metrics_mod
 
 COMMITTED, CONFLICT, TOO_OLD = ck.COMMITTED, ck.CONFLICT, ck.TOO_OLD
 
@@ -123,6 +124,7 @@ class Resolver:
         self.backend = knobs.resolver_backend
         self.base_version = base_version
         self.alive = True
+        self._init_metrics()
         # wall seconds spent inside resolve_many's device dispatch (the
         # scan call; for host backends, the eager resolve) — the batcher
         # subtracts this from its stage-A+B timer so stage_pack_ms
@@ -197,6 +199,33 @@ class Resolver:
         else:
             raise ValueError(f"unknown resolver_backend {self.backend!r}")
 
+    def _init_metrics(self, registry=None):
+        """Build (or adopt) the role registry + hot-path handles.
+        Recruitment hands the replacement the dead instance's registry
+        so resolver counters survive respawns without rewinding."""
+        if registry is not None and registry is not getattr(
+                self, "metrics", None):
+            registry.absorb(self.metrics)
+        self.metrics = registry if registry is not None \
+            else metrics_mod.MetricsRegistry("resolver")
+        self._m_batches = self.metrics.counter("resolve_batches")
+        self._m_txns = self.metrics.counter("resolve_txns")
+        self._m_backlogs = self.metrics.counter("backlog_dispatches")
+        self._m_backlog_depth = self.metrics.gauge("backlog_depth")
+        self._m_flat_fallbacks = self.metrics.counter("flat_fallbacks")
+        self._m_pallas_fallbacks = self.metrics.counter("pallas_fallbacks")
+        self._m_respawns = self.metrics.counter("respawns")
+
+    def status(self):
+        """This role's status RPC payload (leaf of the status doc)."""
+        self.metrics.gauge("lanes").set(getattr(self, "n_lanes", 1))
+        return {
+            "alive": self.alive,
+            "backend": self.backend,
+            "lanes": getattr(self, "n_lanes", 1),
+            "metrics": self.metrics.snapshot(),
+        }
+
     def kill(self):
         """Process death: in-memory conflict history is gone; the
         replacement must fence pre-death read versions (ref: resolver
@@ -207,7 +236,10 @@ class Resolver:
         """A replacement of this resolver's own kind, fenced at
         ``base_version`` (the failure monitor's recruitment hook —
         subclasses recruit their own shape)."""
-        return type(self)(self.knobs, base_version=base_version)
+        new = type(self)(self.knobs, base_version=base_version)
+        new._init_metrics(self.metrics)
+        new._m_respawns.inc()
+        return new
 
     def _make_scan_fn(self, use_fast):
         """Compile the multi-batch scan for resolve_many (subclasses
@@ -227,6 +259,8 @@ class Resolver:
         commit path) in arrival order → list of statuses."""
         if not self.alive:
             raise ResolverDown()
+        self._m_batches.inc()
+        self._m_txns.inc(len(txns))
         if isinstance(txns, FlatTxnBatch):
             return self._resolve_flat(txns, commit_version,
                                       new_window_start)
@@ -292,6 +326,7 @@ class Resolver:
 
             TraceEvent("PallasRingFallback", severity=30).detail(
                 fenced_at=commit_version).log()
+            self._m_pallas_fallbacks.inc()
             self.params = self.params._replace(use_pallas=False)
             self._resolve = ck.make_resolve_fn(self.params)
             self.state = ck.init_state(self.params)
@@ -315,6 +350,7 @@ class Resolver:
         if not self.packer.flat_fits(flat) or (
             len(flat) and int(flat.rv.min()) < self.base_version
         ):
+            self._m_flat_fallbacks.inc()
             return self.resolve(flat.to_txn_requests(), commit_version,
                                 new_window_start)
         use_fast = self._pick_fast_flat([flat])
@@ -384,6 +420,9 @@ class Resolver:
         resolver, packer errors) still raise here; only the
         materialization moves.
         """
+        if len(batches) > 1:
+            self._m_backlogs.inc()
+            self._m_backlog_depth.set(len(batches))
         handle = self._dispatch_many(batches)
         return handle if lazy else handle.wait()
 
@@ -413,10 +452,15 @@ class Resolver:
         if not self.alive:
             raise ResolverDown()
         self._maybe_rebase(batches[-1][1])
+        # the scanned paths below bypass resolve(): count their volume
+        # here (the eager/host route above counts via resolve itself)
+        self._m_batches.inc(len(batches))
+        self._m_txns.inc(sum(len(t) for t, _, _ in batches))
         if all(isinstance(t, FlatTxnBatch) for t, _, _ in batches):
             handle = self._dispatch_flat(batches)
             if handle is not None:
                 return handle
+            self._m_flat_fallbacks.inc()
         # a mixed or flat-ineligible backlog decodes to the legacy path
         batches = [
             (t.to_txn_requests() if isinstance(t, FlatTxnBatch) else t,

@@ -104,6 +104,9 @@ class ClusterService:
             "hello": self.hello,
             "knobs": self.knobs,
             "status": self.status,
+            # the metrics section alone (monitoring agents poll this
+            # without paying for the whole status document)
+            "metrics": self.metrics,
             "get_read_version": self.get_read_version,
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
@@ -151,6 +154,9 @@ class ClusterService:
     def status(self):
         return self.cluster.status()
 
+    def metrics(self):
+        return self.cluster.metrics_status()
+
     def get_read_version(self, priority="default", tags=()):
         return self.cluster.grv_proxy.get_read_version(
             priority, tags=tuple(tags)
@@ -187,13 +193,29 @@ class ClusterService:
         """A client-batched window of commits in ONE RPC (the remote
         BatchingCommitProxy's flush): decoded once, pipelined once —
         per-commit RPCs round-trip-bound multi-process deployments
-        (ref: clients streaming batched commits at the proxy)."""
+        (ref: clients streaming batched commits at the proxy).
+
+        Span accounting: this route bypasses any server-side batching
+        wrapper (deliberately — the window is already batched), so when
+        the bare proxy has ceded commit_e2e ownership to that wrapper,
+        nobody else would record the span; record it here (decode →
+        reply, the server-side view of the client's window)."""
+        from foundationdb_tpu.utils import metrics as metrics_mod
+
         target = getattr(self.cluster.commit_proxy, "inner",
                          self.cluster.commit_proxy)
-        if self._commit_lock is not None:
-            with self._commit_lock:
-                return target.commit_batch(requests)
-        return target.commit_batch(requests)
+        owner = target.inners[0] if hasattr(target, "inners") else target
+        t0 = metrics_mod.now() \
+            if getattr(owner, "spans_owned_externally", False) \
+            and metrics_mod.enabled() else None
+        try:
+            if self._commit_lock is not None:
+                with self._commit_lock:
+                    return target.commit_batch(requests)
+            return target.commit_batch(requests)
+        finally:
+            if t0 is not None:
+                owner._m_e2e.record(max(0.0, metrics_mod.now() - t0))
 
     def watch_register(self, key, seen_value):
         w = self.cluster.read_storage(key).watch(key, seen_value)
@@ -617,6 +639,9 @@ class RemoteCluster:
 
     def status(self):
         return self._call("status")
+
+    def metrics_status(self):
+        return self._call("metrics")
 
     # management surface (the special key space's commit-time handles)
     def exclude_storage(self, sid):

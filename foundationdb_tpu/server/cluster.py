@@ -27,6 +27,7 @@ from foundationdb_tpu.server.router import StorageRouter
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog, TLogSystem
+from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
@@ -55,6 +56,13 @@ class Cluster:
                 else DEFAULT_KNOBS
             )
         self.knobs = knobs
+        # Per-role metric registries, keyed (role, index), owned by the
+        # CLUSTER so they outlive role incarnations: a txn-system
+        # recovery hands the replacement proxies the same registries and
+        # no counter ever goes backwards (the reference's status
+        # counters survive recoveries the same way — they live in the
+        # roles' stats collections aggregated by a long-lived process).
+        self._metrics_store = {}
         self.ratekeeper = Ratekeeper(
             target_tps=target_tps if target_tps is not None else 1e9,
             clock=rk_clock,
@@ -232,12 +240,28 @@ class Cluster:
                 tenant_tag(k[len(TENANT_QUOTA_PREFIX):]), float(v)
             )
 
-    def _make_commit_proxy(self, resolve_gate=None, log_gate=None):
+    def _role_registry(self, role, i=0):
+        """The persistent (role, index) metrics registry — created on
+        first use, reused by every later incarnation of that role."""
+        key = (role, i)
+        reg = self._metrics_store.get(key)
+        if reg is None:
+            reg = self._metrics_store[key] = metrics_mod.MetricsRegistry(
+                role, index=i
+            )
+        return reg
+
+    def _role_registries(self, role):
+        return [reg for (r, _), reg in sorted(self._metrics_store.items())
+                if r == role]
+
+    def _make_commit_proxy(self, resolve_gate=None, log_gate=None, index=0):
         return CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
             self.knobs, self.ratekeeper, dd=self.dd,
             change_feeds=self.change_feeds,
             resolve_gate=resolve_gate, log_gate=log_gate,
+            metrics=self._role_registry("commit_proxy", index),
         )
 
     def _build_txn_frontend(self):
@@ -247,6 +271,14 @@ class Cluster:
         and ordered pipeline gates (ref: the reference's proxy fleets;
         see server/fleet.py). Used for first boot AND txn-system
         recovery — the two incarnations must never diverge."""
+        # a shrinking fleet folds the orphaned indices' metric history
+        # into member 0 so cluster totals never go backwards
+        n = max(1, self.n_commit_proxies)
+        for (role, i) in list(self._metrics_store):
+            if role in ("commit_proxy", "grv_proxy") and i >= n:
+                self._role_registry(role, 0).absorb(
+                    self._metrics_store.pop((role, i))
+                )
         if self.n_commit_proxies <= 1:
             return self._wire_pipeline(self._make_commit_proxy())
         from foundationdb_tpu.server.fleet import GrvFleet, ProxyFleet
@@ -258,11 +290,11 @@ class Cluster:
             VersionGate(start, timeout=t), VersionGate(start, timeout=t),
         )
         inners, members, grvs = [], [], []
-        for _ in range(self.n_commit_proxies):
+        for i in range(self.n_commit_proxies):
             inner = self._make_commit_proxy(
-                resolve_gate=resolve_gate, log_gate=log_gate
+                resolve_gate=resolve_gate, log_gate=log_gate, index=i
             )
-            wrapped, grv = self._wire_pipeline(inner)
+            wrapped, grv = self._wire_pipeline(inner, index=i)
             inners.append(inner)
             members.append(wrapped)
             grvs.append(grv)
@@ -274,7 +306,7 @@ class Cluster:
             return list(cp.inners)
         return [getattr(cp, "inner", cp)]
 
-    def _wire_pipeline(self, inner):
+    def _wire_pipeline(self, inner, index=0):
         """Wrap a bare CommitProxy + fresh GrvProxy in the configured
         pipeline (one wiring for first boot AND txn-system recovery —
         the two incarnations must never diverge). "thread" batches GRVs
@@ -289,7 +321,8 @@ class Cluster:
                 flush_after=self._commit_flush_after,
                 mode=self.commit_pipeline,
             )
-        grv = GrvProxy(self.sequencer, self.ratekeeper)
+        grv = GrvProxy(self.sequencer, self.ratekeeper,
+                       metrics=self._role_registry("grv_proxy", index))
         if self.commit_pipeline == "thread":
             from foundationdb_tpu.server.grv import BatchingGrvProxy
 
@@ -461,6 +494,7 @@ class Cluster:
             window_versions=self.knobs.max_read_transaction_life_versions,
             engine=old.engine,
         )
+        new.adopt_metrics(old.metrics)  # counters survive recruitment
         smap = self.dd.map if self.replication < len(self.storages) else None
         from foundationdb_tpu.core.mutations import Op
 
@@ -790,6 +824,63 @@ class Cluster:
         return {"cluster_type": f"metacluster_{meta['role']}",
                 "name": meta.get("name")}
 
+    def _sum_counter(self, role, name):
+        return sum(
+            reg.counter(name).value for reg in self._role_registries(role)
+        )
+
+    def metrics_status(self):
+        """The aggregated metrics section of the status document (ref:
+        Status.actor.cpp folding every role's stats into one json):
+        cluster-level latency rollups — merged across the role fleets —
+        plus hottest-stage attribution for the commit pipeline."""
+        commit_regs = self._role_registries("commit_proxy")
+        grv_regs = self._role_registries("grv_proxy")
+        commit = metrics_mod.merged_bands_ms(
+            [r.get_latency("commit_e2e") for r in commit_regs]
+        )
+        grv = metrics_mod.merged_bands_ms(
+            [r.get_latency("grv_grant") for r in grv_regs]
+        )
+        logs = self.tlog.logs if isinstance(self.tlog, TLogSystem) \
+            else [self.tlog]
+        push = metrics_mod.merged_bands_ms(
+            [l.metrics.get_latency("tlog_push") for l in logs]
+        )
+        apply_ = metrics_mod.merged_bands_ms(
+            [s.metrics.get_latency("storage_apply") for s in self.storages]
+        )
+        # hottest-stage attribution: the commit-pipeline stage with the
+        # most TOTAL wall time across the fleet is the critical path an
+        # operator should look at first
+        stage_totals = {}
+        for reg in commit_regs:
+            for stage in ("pack", "dispatch", "resolve", "apply"):
+                s = reg.get_latency(f"stage_{stage}")
+                if s is not None and s.count:
+                    stage_totals[stage] = (
+                        stage_totals.get(stage, 0.0) + s.total_seconds()
+                    )
+        hottest = max(stage_totals, key=stage_totals.get) \
+            if stage_totals else None
+        return {
+            "rollups": {
+                "commit_latency_p50_ms": commit["p50_ms"],
+                "commit_latency_p99_ms": commit["p99_ms"],
+                "commit_latency_max_ms": commit["max_ms"],
+                "commit_spans": commit["count"],
+                "grv_latency_p99_ms": grv["p99_ms"],
+                "tlog_push_p99_ms": push["p99_ms"],
+                "storage_apply_p99_ms": apply_["p99_ms"],
+                "hottest_stage": hottest,
+                "hottest_stage_totals_s": {
+                    k: round(v, 6) for k, v in stage_totals.items()
+                },
+            },
+            "commit_latency_bands": commit,
+            "grv_latency_bands": grv,
+        }
+
     def status(self):
         """Cluster status summary (ref: fdbcli status json, Status.actor.cpp
         — processes/roles breakdown, qos, data, recovery state)."""
@@ -834,23 +925,41 @@ class Cluster:
                     "tag_throttled_count": rk.tag_throttled_count,
                 },
                 "workload": {
+                    # counters come from the cluster-held registries, so
+                    # they SURVIVE txn-system recoveries (the live
+                    # proxies' own attrs reset with each incarnation)
                     "transactions": {
-                        "committed": {"counter": self.commit_proxy.commit_count},
-                        "conflicted": {"counter": self.commit_proxy.conflict_count},
-                        "started": {"counter": self.grv_proxy.grv_count},
+                        "committed": {"counter": self._sum_counter(
+                            "commit_proxy", "txn_committed")},
+                        "conflicted": {"counter": self._sum_counter(
+                            "commit_proxy", "abort_not_committed")
+                            + self._sum_counter(
+                                "commit_proxy", "abort_transaction_too_old")},
+                        "started": {"counter": self._sum_counter(
+                            "grv_proxy", "grv_grants")},
                     }
                 },
+                "metrics": self.metrics_status(),
                 "latest_version": self.sequencer.committed_version,
                 "oldest_readable_version": self.storage.oldest_version,
                 "commit_pipeline": self.commit_pipeline,
                 "processes": {
                     "sequencer": {"alive": self.sequencer.alive},
                     "commit_proxy": {"alive": self._commit_target().alive,
-                                     "count": self.n_commit_proxies},
+                                     "count": self.n_commit_proxies,
+                                     "members": [
+                                         p.status()
+                                         for p in self._inner_proxies()
+                                     ]},
+                    "grv_proxies": [
+                        {"id": reg.index, "metrics": reg.snapshot()}
+                        for reg in self._role_registries("grv_proxy")
+                    ],
                     "resolvers": [
                         {"id": i, "alive": r.alive,
                          "backend": self.knobs.resolver_backend,
-                         "lanes": getattr(r, "n_lanes", 1)}
+                         "lanes": getattr(r, "n_lanes", 1),
+                         "metrics": r.metrics.snapshot()}
                         for i, r in enumerate(self.resolvers)
                     ],
                     "storage_servers": [
@@ -860,10 +969,19 @@ class Cluster:
                             "durable_version": s.durable_version,
                             "oldest_version": s.oldest_version,
                             "versioned_engine": s.versioned_engine,
+                            "metrics": s.status()["metrics"],
                         }
                         for i, s in enumerate(self.storages)
                     ],
-                    "logs": tlog_info,
+                    "logs": {
+                        **tlog_info,
+                        "replicas": (
+                            self.tlog.status()
+                            if isinstance(self.tlog, TLogSystem)
+                            else [self.tlog.status()]
+                        ),
+                    },
+                    "ratekeeper": self.ratekeeper.status(),
                 },
                 "resolvers": sum(
                     getattr(r, "n_lanes", 1) for r in self.resolvers

@@ -146,6 +146,12 @@ class ProxyFleet:
             default=1,
         )
 
+    def metrics_snapshots(self):
+        """Per-member metric snapshots (the status doc's commit-proxy
+        members section; each member shares its inner proxy's registry
+        so batcher spans and proxy counters land in one document)."""
+        return [p.metrics.snapshot() for p in self.inners]
+
     def stage_summary(self):
         """Fleet view of the members' commit-pipeline stage timings:
         means across members, worst-case configured depth."""
@@ -183,6 +189,9 @@ class GrvFleet:
     @property
     def grv_count(self):
         return sum(m.grv_count for m in self.members)
+
+    def metrics_snapshots(self):
+        return [m.metrics.snapshot() for m in self.members]
 
     def close(self):
         for m in self.members:

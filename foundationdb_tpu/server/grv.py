@@ -17,13 +17,22 @@ import threading
 import time
 
 from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.utils import metrics as metrics_mod
 
 
 class GrvProxy:
-    def __init__(self, sequencer, ratekeeper=None):
+    def __init__(self, sequencer, ratekeeper=None, metrics=None):
         self.sequencer = sequencer
         self.ratekeeper = ratekeeper
         self.grv_count = 0
+        # persistent across recovery incarnations (the cluster hands the
+        # same registry to the replacement): started-txn counters and
+        # the grant-latency bands must never rewind
+        self.metrics = metrics if metrics is not None \
+            else metrics_mod.MetricsRegistry("grv_proxy")
+        self._m_grants = self.metrics.counter("grv_grants")
+        self._m_throttled = self.metrics.counter("grv_throttled")
+        self._m_tag_throttled = self.metrics.counter("grv_tag_throttled")
 
     def get_read_version(self, priority="default", tags=()):
         if not getattr(self.sequencer, "alive", True):
@@ -37,10 +46,21 @@ class GrvProxy:
                 # tag-throttled (1213) vs cluster-saturated (1037): both
                 # retryable, but the client (and its operator) should
                 # know WHICH gate closed (ref: GrvProxyTagThrottler)
-                raise err("tag_throttled" if reason == "tag"
-                          else "process_behind")
+                if reason == "tag":
+                    self._m_tag_throttled.inc()
+                    raise err("tag_throttled")
+                self._m_throttled.inc()
+                raise err("process_behind")
         self.grv_count += 1
+        self._m_grants.inc()
         return self.sequencer.committed_version
+
+    def status(self):
+        """This role's status RPC payload (leaf of the status doc)."""
+        return {
+            "alive": getattr(self.sequencer, "alive", True),
+            "metrics": self.metrics.snapshot(),
+        }
 
 
 class BatchingGrvProxy:
@@ -65,6 +85,13 @@ class BatchingGrvProxy:
         self.batches_granted = 0
         self.delayed_count = 0  # requests that waited ≥1 extra window
         self.max_round = 0  # largest single-round grant (batch size seen)
+        # grant-latency bands (ref: GrvProxyServer's GRV latency sample):
+        # queued requests record their wait at grant; the uncontended
+        # fast path is counted (its wait is ~0 by construction) so the
+        # bands measure the queue, not a flood of zeros
+        self._m_wait = inner.metrics.latency("grv_grant")
+        self._m_fast = inner.metrics.counter("grv_fast_grants")
+        self._m_queue_depth = inner.metrics.gauge("grv_queue_depth")
         self._thread = None
         if start_thread:
             self._thread = threading.Thread(
@@ -106,6 +133,8 @@ class BatchingGrvProxy:
                 # never steal a refilled token from an older request the
                 # grant loop is currently holding.
                 self.inner.grv_count += 1
+                self.inner._m_grants.inc()
+                self._m_fast.inc()
                 return self.inner.sequencer.committed_version
         fut = self._make_future(priority)
         with self._lock:
@@ -198,6 +227,7 @@ class BatchingGrvProxy:
             # whole queue behind it waits, so no per-future hammering
             # of the token bucket and no younger request overtaking)
             n_granted = 0
+            t_grant = time.monotonic() if now is None else now
             for fut in queue:
                 if rk is not None and not rk.admit(fut["priority"]):
                     break
@@ -205,6 +235,7 @@ class BatchingGrvProxy:
                     version = self.inner.sequencer.committed_version
                     self.batches_granted += 1
                 fut["value"] = version
+                self._m_wait.record(max(0.0, t_grant - fut["born"]))
                 fut["event"].set()
                 n_granted += 1
                 granted_any = True
@@ -232,6 +263,9 @@ class BatchingGrvProxy:
             self.inner.grv_count += round_granted
             self._pending -= resolved
             self.max_round = max(self.max_round, round_granted)
+            depth = self._pending
+        self.inner._m_grants.inc(round_granted)
+        self._m_queue_depth.set(depth)
         return granted_any
 
     def close(self):

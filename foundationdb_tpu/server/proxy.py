@@ -20,6 +20,7 @@ from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
+from foundationdb_tpu.utils import metrics as metrics_mod
 
 
 class GateTimeout(Exception):
@@ -89,8 +90,22 @@ class _PipelinedGroup:
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None,
-                 resolve_gate=None, log_gate=None):
+                 resolve_gate=None, log_gate=None, metrics=None):
         self.alive = True
+        # per-role metrics (ref: Stats.h CounterCollection on the commit
+        # proxy). The cluster hands recovery incarnations the SAME
+        # registry, so counters survive recruitment without rewinding;
+        # abort counters are keyed by error class (_note_abort).
+        self.metrics = metrics if metrics is not None \
+            else metrics_mod.MetricsRegistry("commit_proxy")
+        self._m_committed = self.metrics.counter("txn_committed")
+        self._m_batches = self.metrics.counter("commit_batches")
+        self._abort_counters = {}
+        # commit_e2e spans: recorded HERE for bare (sync) deployments;
+        # a batching wrapper claims ownership at construction and
+        # records the wider submit→settle span instead (queue included)
+        self.spans_owned_externally = False
+        self._m_e2e = self.metrics.latency("commit_e2e")
         # fleet ordering (None when this proxy is the whole fleet)
         self.resolve_gate = resolve_gate
         self.log_gate = log_gate
@@ -123,6 +138,33 @@ class CommitProxy:
         self.resolver_bounds = None  # n-1 split keys; None = static split
         self._pool = None  # lazy thread pool for concurrent sub-resolves
         self.update_resolver_ranges(fence=False)
+
+    def _note_abort(self, name, n=1):
+        """Per-error-class abort accounting (ref: the reference's
+        per-reason txn counters in status json): one counter per error
+        name — conflicts, too-old, unknown-result, admission rejects —
+        so contention is attributable, not one lump."""
+        if n <= 0 or not metrics_mod.enabled():
+            return
+        c = self._abort_counters.get(name)
+        if c is None:
+            c = self._abort_counters[name] = self.metrics.counter(
+                f"abort_{name}"
+            )
+        c.inc(n)
+
+    def _note_result_errors(self, results):
+        """Tally FDBError entries of a finished result list by class."""
+        if not metrics_mod.enabled():
+            return
+        for r in results:
+            if isinstance(r, FDBError):
+                self._note_abort(r.description)
+
+    def status(self):
+        """This role's status RPC payload: liveness + metrics snapshot
+        (the per-process leaf of the aggregated status document)."""
+        return {"alive": self.alive, "metrics": self.metrics.snapshot()}
 
     def update_resolver_ranges(self, fence=True):
         """Derive each resolver's key range from the LIVE DD shard map,
@@ -181,15 +223,21 @@ class CommitProxy:
             # process died; clients retry and the failure monitor
             # recruits a new transaction-system generation (ref: proxy
             # death surfacing as broken connections → 1021)
+            self._note_abort("commit_unknown_result", len(requests))
             return [
                 FDBError.from_name("commit_unknown_result")
                 for _ in requests
             ]
+        t0 = None if self.spans_owned_externally \
+            or not metrics_mod.enabled() else metrics_mod.now()
         try:
             with self._commit_mu:
                 return self._commit_batch_locked(requests)
         except GateTimeout:
             return self._gate_wedged(len(requests))
+        finally:
+            if t0 is not None:
+                self._m_e2e.record(max(0.0, metrics_mod.now() - t0))
 
     def _gate_wedged(self, n):
         """A gate turn went unclaimed (peer died between grant and
@@ -198,6 +246,7 @@ class CommitProxy:
         txn-system recovery (fresh gates), and answer honest 1021s —
         the batch's fate is unknown until the new generation fences."""
         self.kill()
+        self._note_abort("commit_unknown_result", n)
         return [
             FDBError.from_name("commit_unknown_result") for _ in range(n)
         ]
@@ -214,6 +263,7 @@ class CommitProxy:
             if bad is None:
                 passing.append((i, r))
             else:
+                self._note_abort(bad)
                 results[i] = FDBError.from_name(bad)
         if len(passing) == len(requests):
             return None
@@ -304,6 +354,7 @@ class CommitProxy:
             if v is None:
                 passing.append((i, r))
             else:
+                self.metrics.counter("idmp_dedupe_hits").inc()
                 results[i] = v  # the ORIGINAL commit's version: success
         if len(passing) == len(requests):
             return None
@@ -369,6 +420,7 @@ class CommitProxy:
         except SequencerDown:
             # the kill raced past the entry check (TOCTOU): same honest
             # 1021 — a raw exception here would strand batcher futures
+            self._note_abort("commit_unknown_result", len(requests))
             return [
                 FDBError.from_name("commit_unknown_result")
                 for _ in requests
@@ -392,6 +444,7 @@ class CommitProxy:
             # quietly, so a wedged gate cannot replace this KNOWN
             # outcome with blanket 1021s.
             self._skip_turns_quiet(prev, cv)
+            self._note_abort("not_committed", len(requests))
             return [FDBError.from_name("not_committed") for _ in requests]
         except GateTimeout:
             raise
@@ -452,7 +505,18 @@ class CommitProxy:
         the chip (ref: the proxy pipelining resolution across batches)."""
         if (len(self.resolvers) != 1 or not self.alive
                 or not self.sequencer.alive):
+            # per-batch route: commit_batch records its own spans
             return [self.commit_batch(reqs) for reqs in request_batches]
+        t0 = None if self.spans_owned_externally \
+            or not metrics_mod.enabled() else metrics_mod.now()
+        try:
+            return self._commit_batches_outer(request_batches)
+        finally:
+            if t0 is not None:
+                # one span per backlog group: its batches reply together
+                self._m_e2e.record(max(0.0, metrics_mod.now() - t0))
+
+    def _commit_batches_outer(self, request_batches):
         try:
             with self._commit_mu:
                 if getattr(self, "lock_uid", None) is not None:
@@ -514,6 +578,8 @@ class CommitProxy:
             # contiguous in the global order and one gate span covers it
             pairs = self.sequencer.next_commit_versions(len(request_batches))
         except SequencerDown:
+            self._note_abort("commit_unknown_result",
+                             sum(len(r) for r in request_batches))
             return [
                 [FDBError.from_name("commit_unknown_result") for _ in reqs]
                 for reqs in request_batches
@@ -539,6 +605,8 @@ class CommitProxy:
             )
         except ResolverDown:
             self._skip_turns_quiet(first_prev, last_cv)
+            self._note_abort("not_committed",
+                             sum(len(r) for r in request_batches))
             return [
                 [FDBError.from_name("not_committed") for _ in reqs]
                 for reqs in request_batches
@@ -627,10 +695,14 @@ class CommitProxy:
         the rest of the pipeline. Caller contract: begin runs on one
         thread in grant order; finish runs FIFO on one thread."""
         group = _PipelinedGroup(request_batches)
-        err_1021 = lambda: [
-            [FDBError.from_name("commit_unknown_result") for _ in reqs]
-            for reqs in request_batches
-        ]
+        n_total = sum(len(reqs) for reqs in request_batches)
+
+        def err_1021():
+            self._note_abort("commit_unknown_result", n_total)
+            return [
+                [FDBError.from_name("commit_unknown_result") for _ in reqs]
+                for reqs in request_batches
+            ]
         try:
             pairs = self.sequencer.next_commit_versions(len(request_batches))
         except SequencerDown:
@@ -670,6 +742,7 @@ class CommitProxy:
             return group
         except ResolverDown:
             # definitively not committed; the log turn is still owed
+            self._note_abort("not_committed", n_total)
             group.results_list = [
                 [FDBError.from_name("not_committed") for _ in reqs]
                 for reqs in request_batches
@@ -705,6 +778,10 @@ class CommitProxy:
             # already ran; the skip's enter/advance there are no-ops)
             self._skip_turns_quiet(group.first_prev, group.last_cv)
             group.error = e
+            self._note_abort(
+                "commit_unknown_result",
+                sum(len(reqs) for reqs in group.request_batches),
+            )
             return [
                 [FDBError.from_name("commit_unknown_result") for _ in reqs]
                 for reqs in group.request_batches
@@ -717,6 +794,10 @@ class CommitProxy:
                 # nothing may reach the log after the frontier read —
                 # consume the owed turns and answer honest 1021s
                 self._skip_turns_quiet(group.first_prev, group.last_cv)
+                self._note_abort(
+                    "commit_unknown_result",
+                    sum(len(reqs) for reqs in group.request_batches),
+                )
                 return [
                     [FDBError.from_name("commit_unknown_result")
                      for _ in reqs]
@@ -913,7 +994,10 @@ class CommitProxy:
         samples, the tlog push, storage apply, feeds, and reporting —
         everything that mutates shared cluster state."""
         self.conflict_count += batch_conflicts
-        self.commit_count += sum(1 for r in results if not isinstance(r, FDBError))
+        n_ok = sum(1 for r in results if not isinstance(r, FDBError))
+        self.commit_count += n_ok
+        self._m_batches.inc()
+        self._note_result_errors(results)
 
         if self.dd is not None:
             for m in batch_mutations:
@@ -935,14 +1019,14 @@ class CommitProxy:
             # proxies dying with an unacked tlog push). Definitive
             # resolver rejections (not_committed / too_old) stand —
             # those clients may retry without 1021 disambiguation.
-            self.commit_count -= sum(
-                1 for r in results if not isinstance(r, FDBError)
-            )
+            self.commit_count -= n_ok
+            self._note_abort("commit_unknown_result", n_ok)
             return [
                 r if isinstance(r, FDBError)
                 else FDBError.from_name("commit_unknown_result")
                 for r in results
             ]
+        self._m_committed.inc(n_ok)  # monotone: counted only once durable
         for sid, muts in enumerate(routed):
             if not self.storages[sid].alive:
                 # a detected-dead storage misses the batch; recruitment

@@ -19,6 +19,8 @@ same two-loop shape:
 import threading
 import time
 
+from foundationdb_tpu.utils import metrics as metrics_mod
+
 
 class Ratekeeper:
     # lag (in versions) where the budget starts shrinking / hits the floor
@@ -59,6 +61,10 @@ class Ratekeeper:
         # batcher thread feeds observe_commit/update: the token bucket's
         # read-modify-write must not interleave
         self._mu = threading.Lock()
+        # throttle gauges for the status document (ref: the qos section
+        # Ratekeeper feeds in Status.actor.cpp); values are set from the
+        # live fields at snapshot time, so admission pays nothing
+        self.metrics = metrics_mod.MetricsRegistry("ratekeeper")
 
     # ── GRV-edge enforcement (ref: GrvProxy transaction budgets) ──
     def admit(self, priority="default", tags=()):
@@ -297,3 +303,18 @@ class Ratekeeper:
     def set_target_tps(self, tps):
         self.max_tps = float(tps)
         self.target_tps = min(self.target_tps, self.max_tps)
+
+    def status(self):
+        """This role's status RPC payload: the throttle gauges (leaf of
+        the status doc). Gauges are refreshed here rather than on every
+        admission — the hot path stays untouched."""
+        m = self.metrics
+        m.gauge("target_tps").set(self.target_tps)
+        m.gauge("max_tps").set(self.max_tps)
+        m.gauge("throttled").set(self.throttled_count)
+        m.gauge("tag_throttled").set(self.tag_throttled_count)
+        m.gauge("throttled_tags").set(len(self.throttled_tags()))
+        m.gauge("saturation").set(
+            round(1.0 - self.target_tps / max(self.max_tps, 1e-9), 4)
+        )
+        return {"alive": True, "metrics": m.snapshot()}

@@ -24,6 +24,7 @@ from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.keys import KeySelector, key_successor
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
 from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
+from foundationdb_tpu.utils import metrics as metrics_mod
 
 _MISS = object()  # overlay has no entry at-or-below the read version
 
@@ -149,6 +150,14 @@ class StorageServer(RangeReadInterface):
         self.version = self.durable_version  # latest applied
         self.window_versions = window_versions
         self._watches = {}  # key -> list[Watch]
+        # apply/flush-latency bands + volume counters (ref: the storage
+        # server's StorageMetrics fed into status json). Recruitment
+        # hands the replacement this registry so counters never rewind.
+        self.metrics = metrics_mod.MetricsRegistry("storage")
+        self._m_apply = self.metrics.latency("storage_apply")
+        self._m_mutations = self.metrics.counter("mutations_applied")
+        self._m_reads = self.metrics.counter("point_reads")
+        self._m_range_reads = self.metrics.counter("range_reads")
 
     @classmethod
     def recover(cls, engine, log_records, window_versions=5_000_000):
@@ -170,6 +179,7 @@ class StorageServer(RangeReadInterface):
         direct throughput tax on the commit pipeline."""
         if version <= self.version:
             raise ValueError(f"apply out of order: {version} <= {self.version}")
+        t0 = metrics_mod.now()
         with self._mu:
             overlay_get = self._overlay.get
             overlay = self._overlay
@@ -196,6 +206,8 @@ class StorageServer(RangeReadInterface):
                 else:
                     raise ValueError(f"unresolved mutation {m.op} reached storage")
             self.version = version
+        self._m_apply.record(max(0.0, metrics_mod.now() - t0))
+        self._m_mutations.inc(len(mutations))
 
     def _apply_clear_range(self, begin, end, version):
         # tombstone every key the clear shadows: overlay keys in range plus
@@ -311,6 +323,7 @@ class StorageServer(RangeReadInterface):
 
     def get(self, key, version):
         self._check_version(version)
+        self._m_reads.inc()
         with self._mu:
             return self._lookup(key, version)
 
@@ -333,6 +346,7 @@ class StorageServer(RangeReadInterface):
         in-package consumer drains (or drops) the generator within one
         call, so the lock's critical section ends when that call returns
         (CPython closes the abandoned generator at function exit)."""
+        self._m_range_reads.inc()
         with self._mu:
             yield from self._iter_live_locked(begin, end, version, reverse)
 
@@ -465,4 +479,25 @@ class StorageServer(RangeReadInterface):
             if self.versioned_engine:
                 with self._mu:
                     self.engine.prune(min(oldest, self.durable_version))
+
+    def adopt_metrics(self, registry):
+        """Recruitment carryover: the replacement continues the dead
+        instance's registry, so storage counters never rewind."""
+        if registry is self.metrics:
+            return
+        registry.absorb(self.metrics)
+        self.metrics = registry
+        self._m_apply = registry.latency("storage_apply")
+        self._m_mutations = registry.counter("mutations_applied")
+        self._m_reads = registry.counter("point_reads")
+        self._m_range_reads = registry.counter("range_reads")
+
+    def status(self):
+        """This role's status RPC payload (leaf of the status doc)."""
+        self.metrics.gauge("version").set(self.version)
+        self.metrics.gauge("durable_version").set(self.durable_version)
+        self.metrics.gauge("durability_lag_versions").set(
+            max(0, self.version - self.durable_version)
+        )
+        return {"alive": self.alive, "metrics": self.metrics.snapshot()}
 

@@ -28,6 +28,8 @@ import struct
 import threading
 import zlib
 
+from foundationdb_tpu.utils import metrics as metrics_mod
+
 
 class TLogDown(Exception):
     """This log replica is dead (simulation kill or process loss)."""
@@ -49,6 +51,13 @@ class TLog:
         # long-polling peekers (rpc/storageworker.py LogFeed) park here
         # instead of sleep-polling last_version
         self._data_cond = threading.Condition()
+        # push-latency bands + volume counters for the status document
+        # (ref: TLogMetrics in TLogServer.actor.cpp). Durations come off
+        # the injected clock, so sim snapshots replay deterministically.
+        self.metrics = metrics_mod.MetricsRegistry("tlog")
+        self._m_push = self.metrics.latency("tlog_push")
+        self._m_pushes = self.metrics.counter("pushes")
+        self._m_mutations = self.metrics.counter("mutations")
 
     def _wal_append(self, record):
         """Length+CRC-framed durable append (one framing for push and
@@ -71,10 +80,14 @@ class TLog:
             raise TLogDown()
         if self._log and version <= self._log[-1][0]:
             raise ValueError("tlog push out of order")
+        t0 = metrics_mod.now()
         self._log.append((version, mutations))
         if tags is not None:
             self._tags[version] = tags
         self._wal_append((version, mutations))
+        self._m_push.record(max(0.0, metrics_mod.now() - t0))
+        self._m_pushes.inc()
+        self._m_mutations.inc(len(mutations))
         with self._data_cond:
             self._data_cond.notify_all()
 
@@ -165,6 +178,12 @@ class TLog:
     @property
     def last_version(self):
         return self._log[-1][0] if self._log else self._first_version
+
+    def status(self):
+        """This replica's status RPC payload (leaf of the status doc)."""
+        self.metrics.gauge("retained_records").set(len(self._log))
+        self.metrics.gauge("last_version").set(self.last_version)
+        return {"alive": self.alive, "metrics": self.metrics.snapshot()}
 
     def close(self):
         self.alive = False
@@ -342,6 +361,10 @@ class TLogSystem:
         if self.live_count == 0:
             raise TLogDown("no live tlog replicas")
         return max(l.last_version for l in self.logs if l.alive)
+
+    def status(self):
+        """Per-replica status payloads (the status doc's logs section)."""
+        return [log.status() for log in self.logs]
 
     def close(self):
         for log in self.logs:

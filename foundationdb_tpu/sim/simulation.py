@@ -411,6 +411,14 @@ class Simulation:
                 return False
         return True
 
+    def metrics_snapshot(self):
+        """The cluster's aggregated metrics section at the current step.
+        Under one seed this is BYTE-IDENTICAL across runs: registry
+        timestamps come off the sim's step clock and the reservoirs draw
+        from the seeded ``metrics-reservoir`` stream (the determinism
+        test diffs two same-seed sims' snapshots)."""
+        return self.cluster.status()["cluster"]["metrics"]
+
     def quiesce(self):
         """Flush storage so everything is durable (end-of-run barrier);
         recruit any still-dead roles first so the final checks read a
@@ -428,6 +436,10 @@ class Simulation:
         for s in self.cluster.storages:
             s.engine.close()
         self.cluster.tlog.close()
+        # restore the wall clock: leaving the step clock injected would
+        # freeze every LATER (non-sim) cluster's metric spans at this
+        # sim's final step (durations measured as now()-now() = 0)
+        deterministic.registry().reset_clock()
 
     def __enter__(self):
         return self

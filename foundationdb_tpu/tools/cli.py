@@ -244,6 +244,20 @@ class Cli:
             f"  Started             - {w['started']['counter']}",
             f"  Committed           - {w['committed']['counter']}",
             f"  Conflicted          - {w['conflicted']['counter']}",
+        )
+        # latency rollups from the metrics subsystem (ref: the latency
+        # probe section of fdbcli status)
+        roll = c.get("metrics", {}).get("rollups", {})
+        if roll.get("commit_spans"):
+            self._p(
+                "Latency (ms):",
+                f"  Commit p50 / p99    - "
+                f"{roll['commit_latency_p50_ms']} / "
+                f"{roll['commit_latency_p99_ms']}",
+                f"  GRV p99             - {roll['grv_latency_p99_ms']}",
+                f"  Hottest stage       - {roll.get('hottest_stage')}",
+            )
+        self._p(
             f"Generation: {c['generation']}",
             f"Latest version: {c['latest_version']}",
         )

@@ -33,6 +33,7 @@ def contains(key):
     return isinstance(key, bytes) and key.startswith(PREFIX) and key < END
 
 STATUS_JSON = b"\xff\xff/status/json"
+METRICS_JSON = b"\xff\xff/metrics/json"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
@@ -71,9 +72,22 @@ def _conflicting_rows(tr):
     return rows
 
 
+def _metrics_json(tr):
+    """The metrics section alone (rollups + cluster latency bands) —
+    cheaper to poll than the full status document."""
+    cluster = tr._cluster
+    if hasattr(cluster, "metrics_status"):
+        doc = cluster.metrics_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        doc = tr.db.status().get("cluster", {}).get("metrics", {})
+    return json.dumps(doc, sort_keys=True).encode()
+
+
 def get(tr, key):
     if key == STATUS_JSON:
         return json.dumps(tr.db.status(), sort_keys=True).encode()
+    if key == METRICS_JSON:
+        return _metrics_json(tr)
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
     if key == DB_LOCKED:
@@ -101,6 +115,8 @@ def get_range(tr, begin, end, limit=0, reverse=False):
     rows = []
     if begin <= STATUS_JSON < end:
         rows.append((STATUS_JSON, get(tr, STATUS_JSON)))
+    if begin <= METRICS_JSON < end:
+        rows.append((METRICS_JSON, get(tr, METRICS_JSON)))
     if begin <= CONNECTION_STRING < end:
         rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
     rows += [

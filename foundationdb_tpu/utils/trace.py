@@ -30,46 +30,96 @@ _SEV_NAMES = {
 
 
 class TraceLog:
-    """Process-wide sink for TraceEvents (ref: g_traceLog)."""
+    """Process-wide sink for TraceEvents (ref: g_traceLog).
 
-    def __init__(self, path=None, min_severity=SEV_INFO, clock=time.time):
+    File sinks ROLL (ref: flow/Trace.cpp rolled trace files): when the
+    open file passes ``max_file_bytes``, it rotates to ``path.1`` (older
+    rolls shift to ``.2`` … ``.roll_count``, the oldest is deleted) so a
+    long bench or sim run never grows one unbounded file. The in-memory
+    ring buffer is kept ALONGSIDE any open file sink, so ``events()``
+    keeps working for tests even when a path is set.
+    """
+
+    def __init__(self, path=None, min_severity=SEV_INFO, clock=time.time,
+                 max_file_bytes=None, roll_count=None):
         self._lock = threading.Lock()
         self._path = path
         self._file = None
-        self._buffer = []  # kept in memory when no path (tests, simulation)
+        self._file_bytes = 0
+        self._buffer = []  # ring buffer, kept even with a file sink
         self.min_severity = min_severity
         self.clock = clock
         self.max_buffered = 10_000
+        self.closed = False
+        self.max_file_bytes = (
+            max_file_bytes if max_file_bytes is not None
+            else int(os.environ.get("FDB_TPU_TRACE_ROLL_BYTES", 10_000_000))
+        )
+        self.roll_count = (
+            roll_count if roll_count is not None
+            else int(os.environ.get("FDB_TPU_TRACE_ROLL_COUNT", 4))
+        )
 
     def open(self, path):
         with self._lock:
             self._path = path
+            self.closed = False
             if self._file:
                 self._file.close()
             self._file = open(path, "a", buffering=1)
+            self._file_bytes = self._file.tell()
 
     def close(self):
         with self._lock:
+            self.closed = True
             if self._file:
                 self._file.close()
                 self._file = None
+
+    def _roll_locked(self):
+        """Rotate path → path.1 → … → path.roll_count (oldest dropped).
+        roll_count 0 truncates in place — bounded either way."""
+        self._file.close()
+        self._file = None
+        if self.roll_count > 0:
+            oldest = f"{self._path}.{self.roll_count}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.roll_count - 1, 0, -1):
+                src = f"{self._path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self._path}.{i + 1}")
+            os.replace(self._path, f"{self._path}.1")
+        else:
+            os.remove(self._path)
+        self._file = open(self._path, "a", buffering=1)
+        self._file_bytes = 0
 
     def emit(self, event):
         if event["severity"] < self.min_severity:
             return
         line = json.dumps(event, separators=(",", ":"), default=repr)
         with self._lock:
+            if self.closed:
+                return  # interpreter teardown / explicit close: drop
             if self._file is None and self._path is not None:
                 self._file = open(self._path, "a", buffering=1)
+                self._file_bytes = self._file.tell()
             if self._file is not None:
-                self._file.write(line + "\n")
-            else:
-                self._buffer.append(event)
-                if len(self._buffer) > self.max_buffered:
-                    del self._buffer[: self.max_buffered // 2]
+                data = line + "\n"
+                self._file.write(data)
+                self._file_bytes += len(data)
+                if (self.max_file_bytes
+                        and self._file_bytes >= self.max_file_bytes):
+                    self._roll_locked()
+            # the ring buffer fills regardless of the file sink, so
+            # events() serves tests and forensics either way
+            self._buffer.append(event)
+            if len(self._buffer) > self.max_buffered:
+                del self._buffer[: self.max_buffered // 2]
 
     def events(self, type_=None):
-        """Buffered events (memory sink only), newest last."""
+        """Ring-buffered events (file sink or not), newest last."""
         with self._lock:
             return [
                 e for e in self._buffer if type_ is None or e["type"] == type_
@@ -98,15 +148,27 @@ class StageStats:
     consistent snapshot. The bench surfaces ``summary()`` so per-stage
     cost (and which stage is critical-path) lands in the artifact."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
         self._total_s = {}
         self._count = {}
+        # optional metrics registry: every add() also records into a
+        # per-stage LatencySample, so the bench's stage means gain
+        # latency BANDS in status json without a second timing site
+        self._registry = registry
+        self._bands = {}
 
     def add(self, stage, seconds):
         with self._lock:
             self._total_s[stage] = self._total_s.get(stage, 0.0) + seconds
             self._count[stage] = self._count.get(stage, 0) + 1
+        if self._registry is not None:
+            band = self._bands.get(stage)
+            if band is None:
+                band = self._bands[stage] = self._registry.latency(
+                    f"stage_{stage}"
+                )
+            band.record(seconds)
 
     def mean_ms(self, stage):
         with self._lock:
@@ -177,7 +239,16 @@ class TraceEvent:
         return False
 
     def __del__(self):
+        # Log-on-destruct, EXCEPT at interpreter shutdown: a GC pass
+        # after the global sink closed (or after module globals were
+        # torn down to None) must never print spurious errors from a
+        # half-dead runtime. ``closed`` is the explicit signal; the
+        # broad guards cover teardown states where even attribute
+        # access on the sink can fail.
         try:
+            log = self._log
+            if log is None or getattr(log, "closed", False):
+                return
             self.log()
         except Exception:
             pass

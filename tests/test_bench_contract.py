@@ -28,6 +28,8 @@ def test_compact_summary_is_small_and_headline_last():
         # flat columnar pack-path observability (ISSUE 3)
         "pack_path": "flat", "pack_bytes": 6052,
         "pack_reuse_rate": 0.99,
+        # commit/GRV latency bands from the metrics subsystem (ISSUE 4)
+        "commit_p50_ms": 1.1, "commit_p99_ms": 3.2, "grv_p99_ms": 0.4,
         # static-analysis debt (analysis/flowlint.py): 0 must still ride
         "flowlint_findings": 0,
     }
@@ -60,6 +62,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["pack_reuse_rate"] == 0.99
     # lint debt rides the summary — and a clean tree's 0 is not dropped
     assert line["flowlint_findings"] == 0
+    # the measured commit/GRV latency bands ride the summary: the
+    # <2ms-added-p99 target is a tracked number, not prose
+    assert line["commit_p50_ms"] == 1.1
+    assert line["commit_p99_ms"] == 3.2
+    assert line["grv_p99_ms"] == 0.4
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -113,12 +120,36 @@ def test_e2e_line_folds_proxies_and_platform():
                 "stage_pack_ms", "stage_dispatch_ms",
                 "stage_resolve_ms", "stage_apply_ms",
                 "pipeline_depth", "pipeline_depth_effective",
-                "pack_path", "pack_bytes", "pack_reuse_rate"):
+                "pack_path", "pack_bytes", "pack_reuse_rate",
+                "commit_p50_ms", "commit_p99_ms", "grv_p99_ms"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
     assert fields["pipeline_depth"] >= 1
     # the cpu backend never flattens: the knob's fallback is visible
     assert fields["pack_path"] == "legacy"
+    # spans were actually recorded (live bands, not placeholder zeros)
+    assert fields["commit_p99_ms"] >= fields["commit_p50_ms"] >= 0
+    assert fields["commit_p99_ms"] > 0
+
+
+def test_metrics_smoke_contract():
+    """BENCH_MODE=metrics_smoke: the overhead probe emits the budget
+    fields the trajectory tracks, and the enabled run carries live
+    commit bands. One short round here — the unit test checks the
+    contract, the bench run owns the statistically serious comparison."""
+    out = bench.run_metrics_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "metrics_overhead_pct", "overhead_budget_pct",
+                "within_budget", "commit_p50_ms", "commit_p99_ms",
+                "grv_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_metrics_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    assert out["commit_p99_ms"] > 0  # the enabled arm recorded spans
+    # the disabled arm really disabled the registry (kill switch back on)
+    from foundationdb_tpu.utils import metrics as metrics_mod
+
+    assert metrics_mod.enabled()
 
 
 def test_pack_smoke_contract():

@@ -318,8 +318,10 @@ def test_status_json_depth():
     db[b"k"] = b"v"
     st = c.status()["cluster"]
     assert st["database_available"] and not st["degraded"]
-    assert st["processes"]["logs"] == {
-        "count": 3, "live": 3, "quorum": 2, "replicated": True}
+    logs = st["processes"]["logs"]
+    assert {k: logs[k] for k in ("count", "live", "quorum", "replicated")} \
+        == {"count": 3, "live": 3, "quorum": 2, "replicated": True}
+    assert len(logs["replicas"]) == 3  # per-replica metrics ride along
     assert len(st["processes"]["storage_servers"]) == 2
     assert st["processes"]["resolvers"][0]["alive"]
     assert st["qos"]["transactions_per_second_limit"] > 0

@@ -1,0 +1,116 @@
+"""utils/trace.py — TraceLog file rolling (max-size + roll-count), the
+ring buffer staying live alongside a file sink, and the log-on-destruct
+guard that keeps interpreter shutdown silent after the sink closed."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from foundationdb_tpu.utils.trace import (  # noqa: E402
+    SEV_INFO,
+    TraceEvent,
+    TraceLog,
+)
+
+
+def _emit_n(log, n, payload_len=200):
+    for i in range(n):
+        TraceEvent("RollTest", log=log).detail(
+            i=i, pad="x" * payload_len).log()
+
+
+def test_trace_file_rolls_at_max_bytes(tmp_path):
+    path = str(tmp_path / "trace.json")
+    log = TraceLog(path=path, max_file_bytes=2000, roll_count=3)
+    _emit_n(log, 60)
+    log.close()
+    # the live file stays bounded and rolls exist
+    assert os.path.getsize(path) <= 2000 + 300  # one record of slack
+    rolls = [p for p in os.listdir(tmp_path)
+             if p.startswith("trace.json.")]
+    assert rolls, "no rolled trace files were produced"
+    assert len(rolls) <= 3
+    for r in rolls:
+        assert os.path.getsize(tmp_path / r) <= 2000 + 300
+    # rolled files hold valid, older JSON lines (forensics intact)
+    with open(tmp_path / sorted(rolls)[0]) as f:
+        first = json.loads(f.readline())
+    assert first["type"] == "RollTest"
+
+
+def test_roll_count_bounds_total_files(tmp_path):
+    path = str(tmp_path / "t.json")
+    log = TraceLog(path=path, max_file_bytes=500, roll_count=2)
+    _emit_n(log, 200)
+    log.close()
+    files = [p for p in os.listdir(tmp_path) if p.startswith("t.json")]
+    assert len(files) <= 3  # live + .1 + .2, the oldest dropped
+
+
+def test_ring_buffer_lives_alongside_file_sink(tmp_path):
+    """The satellite contract: events() keeps working for tests even
+    when a path is set (previously the file sink starved the buffer)."""
+    path = str(tmp_path / "trace.json")
+    log = TraceLog(path=path)
+    TraceEvent("BothSinks", log=log).detail(x=1).log()
+    assert log.events("BothSinks")[0]["x"] == 1
+    with open(path) as f:
+        assert json.loads(f.readline())["type"] == "BothSinks"
+    log.close()
+
+
+def test_del_after_close_is_silent(capsys):
+    """An unlogged TraceEvent garbage-collected after the sink closed
+    (interpreter shutdown) must not emit or raise."""
+    log = TraceLog()
+    ev = TraceEvent("Orphan", log=log).detail(a=1)
+    log.close()
+    del ev  # __del__ sees a closed sink: drop, don't log
+    assert log.events("Orphan") == []
+    # a closed sink also drops explicit emits (teardown-safe)
+    TraceEvent("PostClose", log=log).log()
+    assert log.events("PostClose") == []
+    out = capsys.readouterr()
+    assert out.out == "" and out.err == ""
+
+
+def test_del_with_none_sink_is_silent():
+    ev = TraceEvent("NoSink", severity=SEV_INFO)
+    ev._log = None  # simulates torn-down module globals at shutdown
+    ev.__del__()  # must not raise
+
+
+def test_reopen_after_close_resumes(tmp_path):
+    path = str(tmp_path / "trace.json")
+    log = TraceLog()
+    log.open(path)
+    TraceEvent("A", log=log).log()
+    log.close()
+    log.open(path)
+    TraceEvent("B", log=log).log()
+    log.close()
+    with open(path) as f:
+        types = [json.loads(ln)["type"] for ln in f]
+    assert types == ["A", "B"]
+
+
+def test_interpreter_shutdown_emits_nothing(tmp_path):
+    """End-to-end: a process that leaves an unlogged TraceEvent alive at
+    exit (after closing the global sink) prints nothing to stderr."""
+    import subprocess
+
+    code = (
+        "from foundationdb_tpu.utils.trace import TraceEvent, "
+        "global_trace_log\n"
+        "ev = TraceEvent('Shutdown').detail(x=1)\n"
+        "global_trace_log().close()\n"
+        # ev dies at interpreter teardown with the sink closed
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120,
+    )
+    assert r.returncode == 0
+    assert "Exception" not in r.stderr and "Error" not in r.stderr
