@@ -19,12 +19,13 @@ reconstruction is exact.
 class CommitRequest:
     __slots__ = ("read_version", "mutations", "_read_conflict_ranges",
                  "_write_conflict_ranges", "report_conflicting_keys",
-                 "lock_aware", "idempotency_id", "flat_conflicts")
+                 "lock_aware", "idempotency_id", "flat_conflicts",
+                 "span_context")
 
     def __init__(self, read_version, mutations, read_conflict_ranges,
                  write_conflict_ranges, report_conflicting_keys=False,
                  lock_aware=False, idempotency_id=None,
-                 flat_conflicts=None):
+                 flat_conflicts=None, span_context=None):
         self.read_version = read_version
         self.mutations = mutations
         self._read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
@@ -39,6 +40,12 @@ class CommitRequest:
         # cannot double-apply
         self.idempotency_id = idempotency_id
         self.flat_conflicts = flat_conflicts
+        # distributed tracing (utils/span.py): the client commit span's
+        # (trace_id, span_id, sampled) context — the commit path's
+        # propagation vehicle, since batched requests from many traced
+        # transactions share one wire frame / batcher queue. None for
+        # untraced (or unsampled) transactions.
+        self.span_context = span_context
 
     @property
     def read_conflict_ranges(self):

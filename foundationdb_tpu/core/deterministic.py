@@ -129,7 +129,11 @@ def unseed():
 
 
 def now():
-    return _registry.now()
+    # reads the clock through the registry's live slot (not a cached
+    # fn) so set_clock/reset_clock swaps take effect, while skipping
+    # the method hop — this sits on per-operation hot paths (metrics
+    # stamps, span begin/end)
+    return _registry._clock()
 
 
 def set_clock(fn):

@@ -66,6 +66,17 @@ class Knobs:
     # recovery (tests shrink it; see server/proxy.py GateTimeout)
     gate_timeout_s: float = 60.0
 
+    # --- distributed tracing (utils/span.py) ---
+    # fraction of transactions that carry a sampled trace (0 = tracing
+    # off; `fdbcli tracing on` / \xff\xff/tracing/enabled turns it to
+    # the 0.01 default-when-enabled). Sampling draws ride the seeded
+    # "span-sample" deterministic stream.
+    tracing_sample_rate: float = 0.0
+    # error/slow-commit promotion: an UNSAMPLED (but tracing-enabled)
+    # transaction whose commit aborts or outlives this bound emits its
+    # client-side buffered spans anyway
+    tracing_slow_commit_ms: float = 200.0
+
     # --- simulation ---
     buggify: bool = False
     buggify_prob: float = 0.05

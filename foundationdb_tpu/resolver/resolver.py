@@ -20,6 +20,7 @@ from foundationdb_tpu.ops import conflict as ck
 from foundationdb_tpu.resolver.packing import BatchPacker
 from foundationdb_tpu.resolver.skiplist import CpuConflictSet
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 
 COMMITTED, CONFLICT, TOO_OLD = ck.COMMITTED, ck.CONFLICT, ck.TOO_OLD
 
@@ -261,6 +262,18 @@ class Resolver:
             raise ResolverDown()
         self._m_batches.inc()
         self._m_txns.inc(len(txns))
+        # HOST-side scan span (the proxy's ambient trace context): the
+        # dispatch wall for this batch. Never inside a traced/jitted
+        # region — FL004 keeps kernel code pure.
+        ssp = span_mod.from_context("resolver.scan", span_mod.current(),
+                                    txns=len(txns))
+        try:
+            return self._resolve_traced(txns, commit_version,
+                                        new_window_start)
+        finally:
+            ssp.finish()
+
+    def _resolve_traced(self, txns, commit_version, new_window_start):
         if isinstance(txns, FlatTxnBatch):
             return self._resolve_flat(txns, commit_version,
                                       new_window_start)
@@ -423,6 +436,21 @@ class Resolver:
         if len(batches) > 1:
             self._m_backlogs.inc()
             self._m_backlog_depth.set(len(batches))
+        ssp = span_mod.from_context("resolver.scan", span_mod.current())
+        if ssp is not span_mod.NULL:
+            # one scan span for the whole backlog dispatch (host-side
+            # only — FL004 keeps kernel code pure). Ambient context is
+            # cleared so the eager host route's per-batch resolve()
+            # calls don't emit nested duplicates.
+            ssp.attr(batches=len(batches),
+                     txns=sum(len(t) for t, _, _ in batches))
+            prior = span_mod.set_current(None)
+            try:
+                handle = self._dispatch_many(batches)
+            finally:
+                span_mod.set_current(prior)
+                ssp.finish()
+            return handle if lazy else handle.wait()
         handle = self._dispatch_many(batches)
         return handle if lazy else handle.wait()
 

@@ -129,6 +129,10 @@ class ClusterService:
             "lock_database": self.cluster.lock_database,
             "unlock_database": self.cluster.unlock_database,
             "lock_uid": self.cluster.lock_uid,
+            # distributed tracing config (fdbcli `tracing`, the
+            # \xff\xff/tracing/ special keys against a remote cluster)
+            "tracing_config": self.cluster.tracing_config,
+            "set_tracing": self._set_tracing,
             "set_tenant_mode": self.cluster.set_tenant_mode,
             "configure": self._configure,
             "tenant_mode": self.cluster.tenant_mode,
@@ -188,6 +192,10 @@ class ClusterService:
         returns the achieved shape so a remote operator can confirm."""
         return self.cluster.configure(commit_proxies=commit_proxies,
                                       resolvers=resolvers)
+
+    def _set_tracing(self, sample_rate=None, enabled=None):
+        return self.cluster.set_tracing(sample_rate=sample_rate,
+                                        enabled=enabled)
 
     def commit_batch(self, requests):
         """A client-batched window of commits in ONE RPC (the remote
@@ -682,6 +690,16 @@ class RemoteCluster:
 
     def set_tag_quota(self, tag, tps):
         return self._call("set_tag_quota", tag, tps)
+
+    def tracing_config(self):
+        return self._call("tracing_config")
+
+    def set_tracing(self, sample_rate=None, enabled=None):
+        out = self._call("set_tracing", sample_rate, enabled)
+        # the sampling knob lives server-side in the knobs doc: drop the
+        # cached copy so this client's next transaction sees the change
+        self._knobs = None
+        return out
 
     # ── storage-worker read balancing ──
     def refresh_workers(self):

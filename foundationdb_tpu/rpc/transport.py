@@ -30,6 +30,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.rpc import wire
+from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
 
 MAX_FRAME = 64 * 1024 * 1024
@@ -187,7 +188,12 @@ class RpcServer:
                 self._authenticate(sock, send_lock, peer)
             while not self._closed.is_set():
                 frame = _recv_frame(sock)
-                kind, seq, method, args = wire.loads(frame)
+                msg = wire.loads(frame)
+                # protocol v5: an optional TRACING frame rides as a 5th
+                # element (the caller's SpanContext); shorter tuples are
+                # the untraced form — peers ignore what isn't there
+                kind, seq, method, args = msg[0], msg[1], msg[2], msg[3]
+                trace_ctx = msg[4] if len(msg) > 4 else None
                 if kind != "q":
                     raise ConnectionLost(f"unexpected message kind {kind!r}")
                 pool = (
@@ -197,7 +203,8 @@ class RpcServer:
                     else self._pool
                 )
                 pool.submit(
-                    self._dispatch, sock, send_lock, seq, method, args
+                    self._dispatch, sock, send_lock, seq, method, args,
+                    trace_ctx,
                 )
         except (ConnectionLost, ConnectionError, OSError, ValueError):
             pass
@@ -209,7 +216,15 @@ class RpcServer:
             except OSError:
                 pass
 
-    def _dispatch(self, sock, send_lock, seq, method, args):
+    def _dispatch(self, sock, send_lock, seq, method, args,
+                  trace_ctx=None):
+        prior_ctx = None
+        if trace_ctx is not None:
+            # install the caller's SpanContext as this handler thread's
+            # ambient context: role code (grv grant, storage reads)
+            # opens child spans off span.current() without every
+            # handler signature growing a tracing parameter
+            prior_ctx = span_mod.set_current(tuple(trace_ctx))
         try:
             fn = self.handlers.get(method)
             if fn is None:
@@ -225,6 +240,9 @@ class RpcServer:
                 method=method, etype=type(e).__name__,
                 error=str(e)[:200]).log()
             reply = wire.dumps(("r", seq, False, f"{type(e).__name__}: {e}"))
+        finally:
+            if trace_ctx is not None:
+                span_mod.set_current(prior_ctx)
         try:
             _send_frame(sock, send_lock, reply)
         except (ConnectionError, OSError):
@@ -349,11 +367,14 @@ class RpcClient:
             self._seq += 1
             seq = self._seq
             self._pending[seq] = fut
+        # the thread's ambient SpanContext (a sampled client span) rides
+        # as the optional v5 tracing frame; untraced calls keep the
+        # 4-tuple form byte-for-byte
+        ctx = span_mod.current()
+        msg = ("q", seq, method, tuple(args)) if ctx is None \
+            else ("q", seq, method, tuple(args), ctx)
         try:
-            _send_frame(
-                self._sock, self._send_lock,
-                wire.dumps(("q", seq, method, tuple(args))),
-            )
+            _send_frame(self._sock, self._send_lock, wire.dumps(msg))
         except (ConnectionError, OSError) as e:
             with self._state_lock:
                 self._pending.pop(seq, None)

@@ -19,7 +19,11 @@ from foundationdb_tpu.core.flatpack import FlatConflicts
 from foundationdb_tpu.core.keys import KeySelector
 from foundationdb_tpu.core.mutations import Mutation, Op
 
-PROTOCOL_VERSION = 4  # v4: columnar commit frame (flat conflict blobs)
+# v4: columnar commit frame (flat conflict blobs)
+# v5: distributed tracing — an optional SpanContext frame on requests
+#     (transport appends it to the "q" tuple; absent = untraced) and a
+#     trailing span_context value on both CommitRequest frames
+PROTOCOL_VERSION = 5
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -98,6 +102,7 @@ def _enc(buf, v):
             buf.append(b"T" if v.report_conflicting_keys else b"F")
             buf.append(b"T" if v.lock_aware else b"F")
             _enc(buf, v.idempotency_id)
+            _enc(buf, v.span_context)  # v5: tracing context (N = none)
             return
         buf.append(b"R")
         _enc(buf, v.read_version)
@@ -107,6 +112,7 @@ def _enc(buf, v):
         buf.append(b"T" if v.report_conflicting_keys else b"F")
         buf.append(b"T" if v.lock_aware else b"F")
         _enc(buf, v.idempotency_id)
+        _enc(buf, v.span_context)  # v5: tracing context (N = none)
     elif t is FlatConflicts:
         buf.append(b"C")
         buf.append(struct.pack(
@@ -196,8 +202,9 @@ def _dec(r: _Reader):
         report = r.take(1) == b"T"
         lock_aware = r.take(1) == b"T"
         idmp = _dec(r)
+        sctx = _dec(r)
         return CommitRequest(rv, muts, rcr, wcr, report, lock_aware,
-                             idempotency_id=idmp)
+                             idempotency_id=idmp, span_context=sctx)
     if tag == b"Q":
         rv = _dec(r)
         muts = _dec(r)
@@ -205,10 +212,12 @@ def _dec(r: _Reader):
         report = r.take(1) == b"T"
         lock_aware = r.take(1) == b"T"
         idmp = _dec(r)
+        sctx = _dec(r)
         # range lists None: reconstructed lazily from the blobs only if
         # a legacy consumer asks (CommitRequest._from_flat)
         return CommitRequest(rv, muts, None, None, report, lock_aware,
-                             idempotency_id=idmp, flat_conflicts=flat)
+                             idempotency_id=idmp, flat_conflicts=flat,
+                             span_context=sctx)
     if tag == b"C":
         num_limbs, rp, rr, wp, wr = struct.unpack(">BIIII", r.take(17))
         return FlatConflicts(

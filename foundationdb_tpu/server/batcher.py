@@ -38,6 +38,7 @@ from collections import deque
 
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import SEV_ERROR, StageStats, TraceEvent
 
 
@@ -407,10 +408,13 @@ class BatchingCommitProxy:
             while len(self._inflight) >= self.pipeline_depth \
                     and self._apply_thread.is_alive():
                 self._inflight_cv.wait(timeout=1.0)
+        t0s = span_mod.now()  # stage-span stamp (cheap; ctx known after)
         d0 = self._dispatch_wall()
         t0 = time.perf_counter()
         pgroup = self.inner.commit_batches_begin(reqs)
         pack_s = time.perf_counter() - t0
+        # the group's trace context was scanned ONCE inside begin
+        gctx = getattr(pgroup, "trace_ctx", None)
         # hand the group to the apply worker BEFORE any other fallible
         # call (FL002): once queued, stage C settles its futures even if
         # this thread dies; the stage timers record after the handoff
@@ -425,6 +429,15 @@ class BatchingCommitProxy:
         dispatch_s = max(0.0, self._dispatch_wall() - d0)
         self.stages.add("pack", max(0.0, pack_s - dispatch_s))
         self.stages.add("dispatch", dispatch_s)
+        if gctx is not None:
+            # per-stage spans mirroring the StageStats split: the pack
+            # span is the host-packing share of begin(), the dispatch
+            # span the device scan call carved off its tail
+            t1s = span_mod.now()
+            cut = max(t0s, t1s - dispatch_s)
+            span_mod.emit_span("stage.pack", gctx, begin=t0s, end=cut)
+            span_mod.emit_span("stage.dispatch", gctx, begin=cut,
+                               end=t1s)
 
     def drain_pipeline(self):
         """Block until every in-flight group has settled (ordering
@@ -471,6 +484,8 @@ class BatchingCommitProxy:
     def _finish_group(self, group_chunks, pgroup):
         """Stage C for one group: finish at the proxy, settle futures
         in order, feed the AIMD backlog and the stage timers."""
+        gctx = getattr(pgroup, "trace_ctx", None)
+        t0s = span_mod.now() if gctx is not None else 0.0
         try:
             results_list = self.inner.commit_batches_finish(pgroup)
         except Exception as e:
@@ -482,6 +497,17 @@ class BatchingCommitProxy:
             self.last_batch_error = pgroup.error
         self.stages.add("resolve", pgroup.resolve_s)
         self.stages.add("apply", pgroup.apply_s)
+        if gctx is not None:
+            # stage-C spans mirroring the timers finish() recorded:
+            # resolve (the host sync stall) from the front of the call,
+            # apply (log push + storage apply) carved off its tail
+            t1s = span_mod.now()
+            span_mod.emit_span(
+                "stage.resolve", gctx, begin=t0s,
+                end=min(t1s, t0s + pgroup.resolve_s))
+            span_mod.emit_span(
+                "stage.apply", gctx,
+                begin=max(t0s, t1s - pgroup.apply_s), end=t1s)
         txns = conflicts = 0
         for chunk, results in zip(group_chunks, results_list):
             self._settle(chunk, results)
@@ -508,12 +534,24 @@ class BatchingCommitProxy:
         submit order is preserved into the chunks) to now. Every txn in
         the window replies together, so this is the honest worst case;
         per batch, not per txn, because tens of thousands of record()
-        calls per second would themselves be commit-path overhead."""
+        calls per second would themselves be commit-path overhead.
+
+        The SAME stamps drive slow-commit promotion (utils/span.py): a
+        window outliving ``tracing_slow_commit_ms`` while tracing is
+        enabled emits a ``commit.window`` span — per-window, like the
+        band itself, so unsampled transactions pay nothing extra."""
         if not metrics_mod.enabled():
             return
         born = chunk[0][1].born if chunk else None
         if born is not None:
-            self._m_e2e.record(max(0.0, metrics_mod.now() - born))
+            end = metrics_mod.now()
+            dur = max(0.0, end - born)
+            self._m_e2e.record(dur)
+            knobs = getattr(self.inner, "knobs", None)
+            if (knobs is not None
+                    and getattr(knobs, "tracing_sample_rate", 0.0) > 0.0
+                    and dur * 1e3 >= knobs.tracing_slow_commit_ms):
+                span_mod.slow_window_span(born, end, txns=len(chunk))
         self._m_settled_batches.inc()
 
     def _fail_chunks(self, chunks, e):

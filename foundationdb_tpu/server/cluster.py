@@ -758,6 +758,44 @@ class Cluster:
         """Operator per-tag rate limit (tenant quotas ride this)."""
         self.ratekeeper.set_tag_quota(tag, tps)
 
+    # ── distributed tracing config (utils/span.py) ──
+    TRACING_DEFAULT_RATE = 0.01  # `tracing on` without an explicit rate
+
+    def tracing_config(self):
+        k = self.knobs
+        return {"enabled": k.tracing_sample_rate > 0,
+                "sample_rate": k.tracing_sample_rate,
+                "slow_commit_ms": k.tracing_slow_commit_ms}
+
+    def set_tracing(self, sample_rate=None, enabled=None):
+        """Live tracing reconfiguration (fdbcli `tracing`, the
+        \\xff\\xff/tracing/ special keys): swaps the cluster's knobs for
+        a copy with the new sample rate — the shared DEFAULT_KNOBS
+        object is never mutated, and new transactions (which resolve
+        knobs per reset through the Database) pick it up immediately."""
+        k = self.knobs
+        if enabled is not None:
+            if enabled:
+                sample_rate = (k.tracing_sample_rate
+                               if k.tracing_sample_rate > 0
+                               else self.TRACING_DEFAULT_RATE)
+            else:
+                sample_rate = 0.0
+        if sample_rate is None:
+            return self.tracing_config()
+        rate = float(sample_rate)
+        if not 0.0 <= rate <= 1.0:
+            raise err("invalid_option_value")
+        self.knobs = dataclasses.replace(k, tracing_sample_rate=rate)
+        # live proxies hold their construction-time knobs reference
+        # (slow-window promotion reads the rate there): hand them the
+        # new object. Sim fault wrappers shadow this harmlessly — sims
+        # configure tracing at construction.
+        for p in self._inner_proxies():
+            p.knobs = self.knobs
+        TraceEvent("TracingConfigured").detail(sample_rate=rate).log()
+        return self.tracing_config()
+
     def consistency_check(self, max_keys_per_shard=None):
         """Replica agreement audit (ref: the ConsistencyCheck workload /
         fdbcli consistencycheck). Returns error strings; [] = clean."""
@@ -881,6 +919,22 @@ class Cluster:
             "grv_latency_bands": grv,
         }
 
+    def _trace_status(self):
+        """The trace/span pipeline's own health: per-type suppression
+        (satellite of flow/Trace.cpp event suppression) and the tracing
+        config + span gauges (utils/span.py)."""
+        from foundationdb_tpu.utils import span as span_mod
+        from foundationdb_tpu.utils.trace import global_trace_log
+
+        log = global_trace_log()
+        return {
+            "suppressed_events": log.suppressed_events,
+            "suppressed_by_type": dict(log.suppressed_by_type),
+            "tracing": self.tracing_config(),
+            "spans_sampled": span_mod.spans_sampled(),
+            "spans_emitted": span_mod.spans_emitted(),
+        }
+
     def status(self):
         """Cluster status summary (ref: fdbcli status json, Status.actor.cpp
         — processes/roles breakdown, qos, data, recovery state)."""
@@ -940,6 +994,11 @@ class Cluster:
                     }
                 },
                 "metrics": self.metrics_status(),
+                # observability plumbing health: process-wide (cumulative
+                # across incarnations, so kept OUT of the deterministic
+                # per-cluster metrics section) — the trace sink's
+                # suppression counters and the span pipeline's gauges
+                "trace": self._trace_status(),
                 "latest_version": self.sequencer.committed_version,
                 "oldest_readable_version": self.storage.oldest_version,
                 "commit_pipeline": self.commit_pipeline,

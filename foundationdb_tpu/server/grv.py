@@ -18,6 +18,7 @@ import time
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 
 
 class GrvProxy:
@@ -53,7 +54,14 @@ class GrvProxy:
                 raise err("process_behind")
         self.grv_count += 1
         self._m_grants.inc()
-        return self.sequencer.committed_version
+        v = self.sequencer.committed_version
+        # a traced request (in-process ambient context or the wire's
+        # tracing frame) gets its grant recorded as a server-side hop
+        ctx = span_mod.current()
+        if ctx is not None:
+            span_mod.emit_span("grv.grant", ctx, version=v,
+                               priority=priority)
+        return v
 
     def status(self):
         """This role's status RPC payload (leaf of the status doc)."""
@@ -120,6 +128,7 @@ class BatchingGrvProxy:
             # budget is charged by the grant loop as usual
             raise err("tag_throttled")
         qkey = "batch" if priority == "batch" else "default"
+        fast_v = None
         with self._lock:
             if (
                 not self._closed
@@ -135,7 +144,17 @@ class BatchingGrvProxy:
                 self.inner.grv_count += 1
                 self.inner._m_grants.inc()
                 self._m_fast.inc()
-                return self.inner.sequencer.committed_version
+                fast_v = self.inner.sequencer.committed_version
+        if fast_v is not None:
+            # span emitted OUTSIDE the grant lock (file sinks write)
+            ctx = span_mod.current()
+            if ctx is not None:
+                span_mod.emit_span("grv.grant", ctx, version=fast_v,
+                                   priority=priority)
+            return fast_v
+        # queued: the span opens at ENQUEUE so its duration is the
+        # grant-queue wait the latency bands measure
+        gsp = span_mod.from_context("grv.grant", span_mod.current())
         fut = self._make_future(priority)
         with self._lock:
             if self._closed:
@@ -146,6 +165,7 @@ class BatchingGrvProxy:
         fut["event"].wait()
         if fut["error"] is not None:
             raise fut["error"]
+        gsp.finish(version=fut["value"], priority=priority, queued=1)
         return fut["value"]
 
     def _grant_loop(self):

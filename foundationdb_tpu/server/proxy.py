@@ -21,6 +21,7 @@ from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 
 
 class GateTimeout(Exception):
@@ -73,7 +74,7 @@ class _PipelinedGroup:
 
     __slots__ = ("request_batches", "metas", "handle", "first_prev",
                  "last_cv", "granted", "results_list", "error",
-                 "resolve_s", "apply_s")
+                 "resolve_s", "apply_s", "trace_ctx")
 
     def __init__(self, request_batches):
         self.request_batches = request_batches
@@ -85,6 +86,10 @@ class _PipelinedGroup:
         self.error = None
         self.resolve_s = 0.0
         self.apply_s = 0.0
+        # the group's first sampled SpanContext, scanned ONCE in begin
+        # (the batcher's stage spans reuse it — re-scanning the whole
+        # group per stage was a measured hot-path cost)
+        self.trace_ctx = None
 
 
 class CommitProxy:
@@ -237,7 +242,7 @@ class CommitProxy:
             return self._gate_wedged(len(requests))
         finally:
             if t0 is not None:
-                self._m_e2e.record(max(0.0, metrics_mod.now() - t0))
+                self._note_e2e(t0, len(requests))
 
     def _gate_wedged(self, n):
         """A gate turn went unclaimed (peer died between grant and
@@ -434,8 +439,21 @@ class CommitProxy:
             # will take (advisor r4: a wedged gate never self-heals)
             self._skip_turns_quiet(prev, cv)
             raise
+        # ambient trace context for the resolver's scan span: the first
+        # sampled member's commit span is the parent (over the wire the
+        # context arrived inside the CommitRequest, so the handler
+        # thread has no ambient one to inherit)
+        rctx = span_mod.first_request_context(requests)
         try:
-            statuses = self._resolve_ordered(txns, cv, window, prev)
+            if rctx is not None:
+                prior_ctx = span_mod.set_current(rctx)
+                try:
+                    statuses = self._resolve_ordered(txns, cv, window,
+                                                     prev)
+                finally:
+                    span_mod.set_current(prior_ctx)
+            else:
+                statuses = self._resolve_ordered(txns, cv, window, prev)
         except ResolverDown:
             # resolution never ran: definitively not committed (1020,
             # retryable without 1021 disambiguation); the failure monitor
@@ -455,7 +473,7 @@ class CommitProxy:
             self._skip_turns_quiet(prev, cv)
             raise
         return self._finalize_batch(requests, txns, statuses, cv, window,
-                                    prev)
+                                    prev, traced=rctx is not None)
 
     def _resolve_ordered(self, txns, cv, window, prev):
         """Resolution in global version order: conflict history is
@@ -514,7 +532,20 @@ class CommitProxy:
         finally:
             if t0 is not None:
                 # one span per backlog group: its batches reply together
-                self._m_e2e.record(max(0.0, metrics_mod.now() - t0))
+                self._note_e2e(
+                    t0, sum(len(r) for r in request_batches))
+
+    def _note_e2e(self, t0, n_txns):
+        """Record the commit_e2e band AND, when tracing is enabled and
+        the window outlived ``tracing_slow_commit_ms``, the per-window
+        slow-commit promotion span — both from the same stamps (the
+        sync-deployment twin of the batcher's _record_span)."""
+        end = metrics_mod.now()
+        dur = max(0.0, end - t0)
+        self._m_e2e.record(dur)
+        if (self.knobs.tracing_sample_rate > 0.0
+                and dur * 1e3 >= self.knobs.tracing_slow_commit_ms):
+            span_mod.slow_window_span(t0, end, txns=n_txns)
 
     def _commit_batches_outer(self, request_batches):
         try:
@@ -597,12 +628,21 @@ class CommitProxy:
             # turns or the rest of the fleet wedges behind it
             self._skip_turns_quiet(first_prev, last_cv)
             raise
+        gctx = span_mod.first_request_context(
+            r for reqs in request_batches for r in reqs
+        )
         if self.resolve_gate is not None:
             self.resolve_gate.enter(first_prev)
         try:
-            statuses_list = self.resolvers[0].resolve_many(
-                [(txns, cv, window) for _, txns, cv, window in metas]
-            )
+            prior_ctx = span_mod.set_current(gctx) \
+                if gctx is not None else None
+            try:
+                statuses_list = self.resolvers[0].resolve_many(
+                    [(txns, cv, window) for _, txns, cv, window in metas]
+                )
+            finally:
+                if gctx is not None:
+                    span_mod.set_current(prior_ctx)
         except ResolverDown:
             self._skip_turns_quiet(first_prev, last_cv)
             self._note_abort("not_committed",
@@ -625,7 +665,8 @@ class CommitProxy:
         try:
             return [
                 self._finalize_batch(reqs, txns, statuses, cv, window,
-                                     prev=None)
+                                     prev=None,
+                                     traced=gctx is not None)
                 for (reqs, txns, cv, window), statuses
                 in zip(metas, statuses_list)
             ]
@@ -721,14 +762,24 @@ class CommitProxy:
             group.error = e
             group.results_list = err_1021()
             return group
+        gctx = group.trace_ctx = span_mod.first_request_context(
+            r for reqs in request_batches for r in reqs
+        )
         try:
             if self.resolve_gate is not None:
                 self.resolve_gate.enter(group.first_prev)
             try:
-                group.handle = self.resolvers[0].resolve_many(
-                    [(txns, cv, window) for _, txns, cv, window in metas],
-                    lazy=True,
-                )
+                prior_ctx = span_mod.set_current(gctx) \
+                    if gctx is not None else None
+                try:
+                    group.handle = self.resolvers[0].resolve_many(
+                        [(txns, cv, window)
+                         for _, txns, cv, window in metas],
+                        lazy=True,
+                    )
+                finally:
+                    if gctx is not None:
+                        span_mod.set_current(prior_ctx)
             finally:
                 if self.resolve_gate is not None:
                     self.resolve_gate.advance(group.last_cv)
@@ -814,7 +865,9 @@ class CommitProxy:
             try:
                 return [
                     self._finalize_batch(reqs, txns, statuses, cv, window,
-                                         prev=None)
+                                         prev=None,
+                                         traced=group.trace_ctx
+                                         is not None)
                     for (reqs, txns, cv, window), statuses
                     in zip(group.metas, statuses_list)
                 ]
@@ -901,7 +954,7 @@ class CommitProxy:
         return out
 
     def _finalize_batch(self, requests, txns, statuses, cv, window,
-                        prev=None):
+                        prev=None, traced=True):
         """Everything after resolution: result assembly, DD accounting,
         tlog push (1021 on quorum loss), storage apply, change feeds,
         version reporting, admission + durability pumping. ``prev``
@@ -909,6 +962,14 @@ class CommitProxy:
         gate (None = the caller already holds the order); assembly and
         routing run OUTSIDE the ordered section so a fleet overlaps
         them with another proxy's push."""
+        # the batch-level span: parented to the FIRST sampled member's
+        # commit span, linking every sampled member (ref: the commit
+        # batch span in CommitProxyServer carrying txn tokens); made
+        # ambient around the ordered tail so the tlog.push and
+        # storage.apply hop spans nest under it. ``traced`` False means
+        # the caller already KNOWS no member carries a context — the
+        # per-request scan is skipped (a measured per-batch cost).
+        bsp = span_mod.batch_span(requests) if traced else span_mod.NULL
         try:
             results = []
             batch_mutations = []
@@ -980,10 +1041,20 @@ class CommitProxy:
         if prev is not None and self.log_gate is not None:
             self.log_gate.enter(prev)
         try:
-            return self._finalize_ordered(
-                requests, results, batch_mutations, batch_conflicts,
-                routed, tags, cv, window,
-            )
+            if bsp is span_mod.NULL:
+                return self._finalize_ordered(
+                    requests, results, batch_mutations, batch_conflicts,
+                    routed, tags, cv, window,
+                )
+            prior_ctx = span_mod.set_current(bsp.context())
+            try:
+                return self._finalize_ordered(
+                    requests, results, batch_mutations, batch_conflicts,
+                    routed, tags, cv, window,
+                )
+            finally:
+                span_mod.set_current(prior_ctx)
+                bsp.finish(version=cv, conflicts=batch_conflicts)
         finally:
             if prev is not None and self.log_gate is not None:
                 self.log_gate.advance(cv)

@@ -25,6 +25,7 @@ from foundationdb_tpu.core.keys import KeySelector, key_successor
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
 from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 
 _MISS = object()  # overlay has no entry at-or-below the read version
 
@@ -179,6 +180,10 @@ class StorageServer(RangeReadInterface):
         direct throughput tax on the commit pipeline."""
         if version <= self.version:
             raise ValueError(f"apply out of order: {version} <= {self.version}")
+        # a traced batch (the proxy's ambient batch-span context) gets
+        # a storage.apply hop span alongside the latency band
+        asp = span_mod.from_context("storage.apply", span_mod.current(),
+                                    version=version)
         t0 = metrics_mod.now()
         with self._mu:
             overlay_get = self._overlay.get
@@ -208,6 +213,7 @@ class StorageServer(RangeReadInterface):
             self.version = version
         self._m_apply.record(max(0.0, metrics_mod.now() - t0))
         self._m_mutations.inc(len(mutations))
+        asp.finish(mutations=len(mutations))
 
     def _apply_clear_range(self, begin, end, version):
         # tombstone every key the clear shadows: overlay keys in range plus

@@ -29,6 +29,7 @@ import threading
 import zlib
 
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import span as span_mod
 
 
 class TLogDown(Exception):
@@ -40,6 +41,7 @@ class TLog:
         self._log = []  # list[(version, mutations)]
         self._tags = {}  # version -> {tag: [mutations]} (memory only)
         self._first_version = 0
+        self.index = 0  # replica id (TLogSystem numbers its members)
         self.wal_path = wal_path
         self.fsync = fsync
         self.alive = True
@@ -80,6 +82,11 @@ class TLog:
             raise TLogDown()
         if self._log and version <= self._log[-1][0]:
             raise ValueError("tlog push out of order")
+        # a traced batch (the proxy's ambient batch-span context) gets
+        # a per-REPLICA push span — the hop the critical-path tool
+        # attributes WAL/fsync time to
+        psp = span_mod.from_context("tlog.push", span_mod.current(),
+                                    replica=self.index, version=version)
         t0 = metrics_mod.now()
         self._log.append((version, mutations))
         if tags is not None:
@@ -88,6 +95,7 @@ class TLog:
         self._m_push.record(max(0.0, metrics_mod.now() - t0))
         self._m_pushes.inc()
         self._m_mutations.inc(len(mutations))
+        psp.finish(mutations=len(mutations))
         with self._data_cond:
             self._data_cond.notify_all()
 
@@ -245,6 +253,8 @@ class TLogSystem:
             TLog(wal_path=f"{wal_path}.{i}" if wal_path else None, fsync=fsync)
             for i in range(n)
         ]
+        for i, log in enumerate(self.logs):
+            log.index = i  # replica id on each push span
         self._pop_holds = {}
         self._data_cond = threading.Condition()
 

@@ -138,6 +138,7 @@ class Cli:
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  metacluster create|status|register|attach|remove|tenant",
+            "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
@@ -470,6 +471,43 @@ class Cli:
                 self._p("no quota" if quota is None else f"{quota} tps")
         else:
             raise ValueError(f"unknown tenant subcommand {sub}")
+
+    def _cmd_tracing(self, args):
+        """Distributed tracing config, wired through the
+        ``\\xff\\xff/tracing/`` special-key space (so the same command
+        works against in-process and remote clusters): ``tracing
+        status`` reads the module rows; ``on`` / ``off`` / ``sample
+        RATE`` write them (applied at commit like other management
+        writes)."""
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        sub = args[0] if args else "status"
+        if sub == "status":
+            def read(tr):
+                return (tr.get(sk.TRACING_ENABLED),
+                        tr.get(sk.TRACING_RATE))
+
+            enabled, rate = self._run(read)
+            state = "on" if enabled == b"1" else "off"
+            self._p(f"Tracing: {state} (sample rate "
+                    f"{(rate or b'0').decode()})")
+        elif sub == "on":
+            self._run(lambda tr: tr.set(sk.TRACING_ENABLED, b"1"))
+            self._p("Tracing enabled")
+        elif sub == "off":
+            self._run(lambda tr: tr.set(sk.TRACING_ENABLED, b"0"))
+            self._p("Tracing disabled")
+        elif sub == "sample":
+            if len(args) < 2:
+                raise ValueError("usage: tracing sample RATE")
+            rate = args[1]
+            float(rate)  # malformed rates fail HERE, not at commit
+            self._run(lambda tr: tr.set(sk.TRACING_RATE, rate.encode()))
+            self._p(f"Tracing sample rate set to {rate}")
+        else:
+            raise ValueError(
+                "usage: tracing status | on | off | sample RATE"
+            )
 
     def _cmd_throttle(self, args):
         """Ref: fdbcli throttle — per-tag rate limits. ``throttle on

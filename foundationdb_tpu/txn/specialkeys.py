@@ -38,6 +38,15 @@ CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
 DB_LOCKED = b"\xff\xff/management/db_locked"
+# distributed tracing (ref: the \xff\xff/tracing/ module in
+# SpecialKeySpace.actor.cpp): ``token`` is TRANSACTION-local — writing
+# a nonzero value forces this transaction's trace to be sampled (b"0"
+# un-forces); ``sample_rate`` / ``enabled`` are cluster config applied
+# at commit like other management writes
+TRACING = b"\xff\xff/tracing/"
+TRACING_TOKEN = b"\xff\xff/tracing/token"
+TRACING_RATE = b"\xff\xff/tracing/sample_rate"
+TRACING_ENABLED = b"\xff\xff/tracing/enabled"
 
 
 def _excluded_rows(tr):
@@ -83,6 +92,48 @@ def _metrics_json(tr):
     return json.dumps(doc, sort_keys=True).encode()
 
 
+def _tracing_rows(tr):
+    """The tracing module's materialized rows (cluster config + this
+    transaction's token), RYW-overlaid with pending tracing writes."""
+    from foundationdb_tpu.utils import span as span_mod
+
+    cfg = _tracing_config(tr)
+    rate, enabled = cfg["sample_rate"], cfg["enabled"]
+    for op, val in tr._special_writes:
+        if op == "tracing_rate":
+            rate, enabled = val, val > 0
+        elif op == "tracing_enabled":
+            enabled = val
+            rate = _DEFAULT_ENABLED_RATE if val and rate <= 0 else (
+                rate if val else 0.0
+            )
+    sp = tr._span
+    if tr._trace_forced or (
+        sp is not None and sp is not span_mod.NULL and sp.sampled
+    ):
+        token = (b"%016x" % sp.context()[0]) if sp is not None \
+            and sp is not span_mod.NULL else b"1"
+    else:
+        token = b"0"
+    return [
+        (TRACING_ENABLED, b"1" if enabled else b"0"),
+        (TRACING_RATE, repr(rate).encode()),
+        (TRACING_TOKEN, token),
+    ]
+
+
+_DEFAULT_ENABLED_RATE = 0.01  # `tracing on` without an explicit rate
+
+
+def _tracing_config(tr):
+    cluster = tr._cluster
+    if hasattr(cluster, "tracing_config"):
+        return cluster.tracing_config()
+    k = tr._knobs
+    return {"enabled": k.tracing_sample_rate > 0,
+            "sample_rate": k.tracing_sample_rate}
+
+
 def get(tr, key):
     if key == STATUS_JSON:
         return json.dumps(tr.db.status(), sort_keys=True).encode()
@@ -98,6 +149,11 @@ def get(tr, key):
             elif op == "unlock":
                 uid = None
         return uid
+    if key.startswith(TRACING):
+        for k, v in _tracing_rows(tr):
+            if k == key:
+                return v
+        return None
     if key.startswith(CONFLICTING_KEYS):
         for k, v in _conflicting_rows(tr):
             if k == key:
@@ -123,6 +179,7 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         (k, v) for k, v in _conflicting_rows(tr) if begin <= k < end
     ]
     rows += [(k, v) for k, v in _excluded_rows(tr) if begin <= k < end]
+    rows += [(k, v) for k, v in _tracing_rows(tr) if begin <= k < end]
     if begin <= DB_LOCKED < end:
         # same RYW overlay as the point get; the row exists only while
         # locked (an unlocked database has no db_locked row to list)
@@ -144,6 +201,28 @@ def write(tr, key, value):
     if key == DB_LOCKED:
         tr._special_writes.append(("lock", value or b"lock"))
         return
+    if key == TRACING_TOKEN:
+        # txn-local, immediate (ref: the reference's tracing token):
+        # nonzero forces THIS transaction sampled, b"0" un-forces
+        if value and value != b"0":
+            tr.options.set_trace()
+        else:
+            tr._trace_forced = False
+        return
+    if key == TRACING_RATE:
+        try:
+            rate = float(value)
+        except (TypeError, ValueError):
+            raise err("invalid_option_value") from None
+        if not 0.0 <= rate <= 1.0:
+            raise err("invalid_option_value")
+        tr._special_writes.append(("tracing_rate", rate))
+        return
+    if key == TRACING_ENABLED:
+        tr._special_writes.append(
+            ("tracing_enabled", value not in (None, b"", b"0"))
+        )
+        return
     raise err("key_outside_legal_range")
 
 
@@ -154,6 +233,12 @@ def clear(tr, key):
         return
     if key == DB_LOCKED:
         tr._special_writes.append(("unlock", None))
+        return
+    if key == TRACING_TOKEN:
+        tr._trace_forced = False  # txn-local, immediate (like write 0)
+        return
+    if key == TRACING_ENABLED:
+        tr._special_writes.append(("tracing_enabled", False))
         return
     raise err("key_outside_legal_range")
 
@@ -197,4 +282,8 @@ def commit_special(tr):
             tr._cluster.lock_database(arg)
         elif op == "unlock":
             tr._cluster.unlock_database()
+        elif op == "tracing_rate":
+            tr._cluster.set_tracing(sample_rate=arg)
+        elif op == "tracing_enabled":
+            tr._cluster.set_tracing(enabled=arg)
     tr._special_writes = []

@@ -22,6 +22,7 @@ from foundationdb_tpu.core.versions import Versionstamp
 from foundationdb_tpu.server.proxy import CommitRequest
 from foundationdb_tpu.txn import specialkeys
 from foundationdb_tpu.txn.rows import WriteMap
+from foundationdb_tpu.utils import span as span_mod
 
 _INVALID = object()
 
@@ -106,6 +107,19 @@ class TransactionOptions:
         becomes exactly-once without the caller inventing tokens."""
         self._tr._auto_idempotency = True
 
+    def set_trace(self):
+        """Force this transaction's trace to be SAMPLED regardless of
+        ``tracing_sample_rate`` (ref: the DEBUG_TRANSACTION_IDENTIFIER
+        / LOG_TRANSACTION option pair; also reachable by writing
+        ``\\xff\\xff/tracing/token``). Best set before the first
+        operation; a late force still promotes the buffered spans at
+        commit."""
+        self._tr._trace_forced = True
+        if self._tr._span is span_mod.NULL:
+            # tracing looked off when the root was (not) created:
+            # rebuild sampled on next use — nothing was recorded yet
+            self._tr._span = None
+
 
 class _Snapshot:
     """Snapshot-isolation view: reads add no read conflict ranges."""
@@ -174,6 +188,15 @@ class Transaction:
         self._special_writes = []  # buffered \xff\xff management writes
         self._conflicting_ranges = None  # from a failed reporting commit
         self._watches_pending = []  # [(key, seen_value, Watch-placeholder)]
+        # distributed tracing (utils/span.py): the lazy root span (None
+        # until the first traced op; NULL when unsampled or off), the
+        # in-flight commit span, and the per-txn force-sample flag. The
+        # unsampled path keeps NO stamps or objects (the ≤2% budget):
+        # abort promotion reconstructs on the error path, slow-commit
+        # promotion is the batcher's per-window record.
+        self._span = None
+        self._commit_span = None
+        self._trace_forced = False
         # options/snapshot views are lazy: most transactions never touch
         # them, and two object constructions per txn is real hot-path cost
         self._options = None
@@ -193,14 +216,48 @@ class Transaction:
             s = self._snapshot_view = _Snapshot(self)
         return s
 
+    # ─────────────────────────── tracing ──────────────────────────────
+    def _trace_span(self):
+        """The lazy root span: NULL when tracing is off or the draw
+        missed, an emitting span when the per-txn force (or the draw)
+        hits. Created on the first traced operation so untraced
+        transactions never touch the sampling stream. Unsampled txns
+        under an ENABLED rate arm promotion in _build_commit_request
+        with a single clock stamp — no span objects on the 99% path."""
+        sp = self._span
+        if sp is None:
+            sp = self._span = span_mod.transaction_span(
+                self._knobs.tracing_sample_rate,
+                forced=self._trace_forced,
+            )
+        return sp
+
     # ─────────────────────────── versions ─────────────────────────────
     def get_read_version(self):
         if self._read_version is None:
             grv = self._cluster.grv_proxy
-            self._read_version = (
-                grv.get_read_version(tags=tuple(self._tags))
-                if self._tags else grv.get_read_version()
-            )
+            sp = self._trace_span()
+            if not sp.sampled:
+                # NULL or deferred: per-op child spans only exist for
+                # SAMPLED traces — the deferred (promotion) record is
+                # root + commit, kept cheap enough for the ≤2% budget
+                self._read_version = (
+                    grv.get_read_version(tags=tuple(self._tags))
+                    if self._tags else grv.get_read_version()
+                )
+                return self._read_version
+            gsp = sp.child("txn.grv")
+            # ambient context: an in-process GrvProxy (or the RPC
+            # transport's tracing frame) parents its grant span here
+            prior = span_mod.set_current(gsp.context())
+            try:
+                self._read_version = (
+                    grv.get_read_version(tags=tuple(self._tags))
+                    if self._tags else grv.get_read_version()
+                )
+            finally:
+                span_mod.set_current(prior)
+            gsp.finish(version=self._read_version)
         return self._read_version
 
     def set_read_version(self, version):
@@ -228,6 +285,34 @@ class Transaction:
         if self._state == "cancelled":
             raise err("transaction_cancelled")
 
+    def _traced_read(self, key, rv):
+        """One storage point read, wrapped in a ``txn.read`` span when
+        this transaction is traced (the span's context rides the read
+        RPC as the wire's tracing frame)."""
+        sp = self._span
+        if sp is None or not sp.sampled:
+            return self._cluster.read_storage(key).get(key, rv)
+        rsp = sp.child("txn.read")
+        prior = span_mod.set_current(rsp.context())
+        try:
+            return self._cluster.read_storage(key).get(key, rv)
+        finally:
+            span_mod.set_current(prior)
+            rsp.finish()
+
+    def _traced_range(self, st, b, e, rv, limit, reverse):
+        """One storage range read under a ``txn.read_range`` span."""
+        sp = self._span
+        if sp is None or not sp.sampled:
+            return st.get_range(b, e, rv, limit=limit, reverse=reverse)
+        rsp = sp.child("txn.read_range")
+        prior = span_mod.set_current(rsp.context())
+        try:
+            return st.get_range(b, e, rv, limit=limit, reverse=reverse)
+        finally:
+            span_mod.set_current(prior)
+            rsp.finish()
+
     def get(self, key, snapshot=False):
         self._guard()
         key = _check_key(key)
@@ -239,11 +324,11 @@ class Transaction:
             if known:
                 if not needs_base:
                     return self._writes.fold(entry, None)
-                base = self._cluster.read_storage(key).get(key, rv)
+                base = self._traced_read(key, rv)
                 if not snapshot:
                     self._add_read_conflict(key, key_successor(key))
                 return self._writes.fold(entry, base)
-        val = self._cluster.read_storage(key).get(key, rv)
+        val = self._traced_read(key, rv)
         if not snapshot:
             self._add_read_conflict(key, key_successor(key))
         return val
@@ -296,9 +381,9 @@ class Transaction:
         if not overlaps:
             # fast path: no uncommitted writes in range — push limit/reverse
             # down to storage instead of materializing the whole range
-            out = st.get_range(b, e, rv, limit=limit, reverse=reverse)
+            out = self._traced_range(st, b, e, rv, limit, reverse)
         else:
-            rows = dict(st.get_range(b, e, rv, limit=0, reverse=False))
+            rows = dict(self._traced_range(st, b, e, rv, 0, False))
             for cb, ce in self._writes.cleared_in(b, e):
                 for k in [k for k in rows if cb <= k < ce]:
                     del rows[k]
@@ -548,6 +633,14 @@ class Transaction:
             flat = flatpack.encode_conflicts(
                 rcr, wcr, self._knobs.key_limbs
             )
+        # commit span (submit → settle): its context rides the request —
+        # the server batch/stage spans parent to it
+        sctx = None
+        sp = self._trace_span()
+        if sp is not span_mod.NULL:
+            csp = self._commit_span = sp.child(
+                "txn.commit", mutations=len(self._mutation_log))
+            sctx = csp.context()
         return CommitRequest(
             read_version=rv,
             mutations=list(self._mutation_log),
@@ -557,6 +650,7 @@ class Transaction:
             lock_aware=self._lock_aware,
             idempotency_id=idmp,
             flat_conflicts=flat,
+            span_context=sctx,
         )
 
     def _ensure_idempotency_id(self):
@@ -599,12 +693,14 @@ class Transaction:
                 self._conflicting_ranges = getattr(
                     result, "conflicting_key_ranges", None
                 )
+                self._trace_commit_done(result)
                 raise result
         # the data half is durable regardless of what the management
         # half does below: record it first so the client can always
         # observe what committed (mixed transactions are not atomic)
         self._committed_version = result
         self._versionstamp = Versionstamp.from_version(result).tr_version
+        self._trace_commit_done(None)
         try:
             specialkeys.commit_special(self)
         except FDBError as e:
@@ -622,6 +718,42 @@ class Transaction:
                 raise
         self._state = "committed"
         self._activate_watches()
+
+    def _trace_commit_done(self, error):
+        """Settle the transaction's trace. Sampled: finish the commit
+        span and the root. Unsampled-but-enabled: the ABORT promotion
+        gate — a commit that failed (or was force-traced too late to
+        re-root) reconstructs and emits its record on the error path;
+        the happy path keeps nothing (slow-commit promotion is the
+        batcher's per-window ``commit.window`` record instead — the
+        per-txn clock stamps this once took busted the ≤2% budget)."""
+        root = self._span
+        if root is None:
+            return
+        if root is span_mod.NULL:
+            if ((error is not None or self._trace_forced)
+                    and self._knobs.tracing_sample_rate > 0.0):
+                end = span_mod.now()
+                span_mod.promote_lite(
+                    end, end, commit_begin=end,
+                    error_code=None if error is None else error.code,
+                    retries=self._retries,
+                )
+            self._span = None
+            return
+        csp = self._commit_span
+        if csp is not None:
+            if error is not None:
+                csp.finish(status="error", error_code=error.code)
+            else:
+                csp.finish(status="committed",
+                           version=self._committed_version)
+            self._commit_span = None
+        root.finish(
+            status="error" if error is not None else "committed",
+            retries=self._retries,
+        )
+        self._span = None  # settled: a reused handle restarts its trace
 
     def _lookup_idempotency(self):
         """Best-effort id-row check at a fresh read version: the commit
@@ -659,6 +791,7 @@ class Transaction:
             specialkeys.commit_special(self)
             self._state = "committed"
             self._activate_watches()
+            self._trace_commit_done(None)
             return
         self._precheck_special_lock()
         self._finish_commit(
@@ -683,6 +816,7 @@ class Transaction:
             specialkeys.commit_special(self)
             self._state = "committed"
             self._activate_watches()
+            self._trace_commit_done(None)
             fut = CommitFuture()
             fut.set(None)
             return fut
@@ -725,11 +859,13 @@ class Transaction:
         # onError)
         keep = (self._retries, self._backoff, self._retry_limit,
                 self._max_retry_delay, self._timeout_s,
-                self._idempotency_id, self._auto_idempotency)
+                self._idempotency_id, self._auto_idempotency,
+                self._trace_forced)
         self._reset()
         (self._retries, self._backoff, self._retry_limit,
          self._max_retry_delay, self._timeout_s,
-         self._idempotency_id, self._auto_idempotency) = keep
+         self._idempotency_id, self._auto_idempotency,
+         self._trace_forced) = keep
 
     def reset(self):
         self._reset()

@@ -13,6 +13,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 
 SEV_DEBUG = 5
 SEV_INFO = 10
@@ -41,15 +42,18 @@ class TraceLog:
     """
 
     def __init__(self, path=None, min_severity=SEV_INFO, clock=time.time,
-                 max_file_bytes=None, roll_count=None):
+                 max_file_bytes=None, roll_count=None, type_budget=None,
+                 suppression_interval_s=None):
         self._lock = threading.Lock()
         self._path = path
         self._file = None
         self._file_bytes = 0
-        self._buffer = []  # ring buffer, kept even with a file sink
+        self.max_buffered = 10_000
+        # a bounded deque IS the ring: append past maxlen evicts the
+        # oldest in O(1) (the old list-trim was O(n) per hot event)
+        self._buffer = deque(maxlen=self.max_buffered)
         self.min_severity = min_severity
         self.clock = clock
-        self.max_buffered = 10_000
         self.closed = False
         self.max_file_bytes = (
             max_file_bytes if max_file_bytes is not None
@@ -59,6 +63,26 @@ class TraceLog:
             roll_count if roll_count is not None
             else int(os.environ.get("FDB_TPU_TRACE_ROLL_COUNT", 4))
         )
+        # per-type rate suppression (ref: flow/Trace.cpp event
+        # suppression): identical event types past the per-interval
+        # budget are DROPPED and counted, so a hot-loop SEV_ERROR can
+        # no longer flood the ring and roll every file. 0 disables.
+        # The default sits well above legitimate traffic (a 1%-sampled
+        # tracing e2e emits ~6k Span events per 5s) — this is a flood
+        # breaker, not a sampler.
+        self.type_budget = (
+            type_budget if type_budget is not None
+            else int(os.environ.get("FDB_TPU_TRACE_TYPE_BUDGET", 20_000))
+        )
+        self.suppression_interval_s = (
+            suppression_interval_s if suppression_interval_s is not None
+            else float(os.environ.get("FDB_TPU_TRACE_SUPPRESS_INTERVAL",
+                                      5.0))
+        )
+        self._type_counts = {}
+        self._window_start = None
+        self.suppressed_events = 0
+        self.suppressed_by_type = {}
 
     def open(self, path):
         with self._lock:
@@ -95,17 +119,51 @@ class TraceLog:
         self._file = open(self._path, "a", buffering=1)
         self._file_bytes = 0
 
+    def _suppress_locked(self, event):
+        """Whether this event exceeds its type's per-interval budget
+        (drop + count). The window rides the sink's injected clock, so
+        sim suppression decisions replay deterministically."""
+        if not self.type_budget:
+            return False
+        t = event.get("time")
+        if t is None:
+            t = self.clock()
+        if (self._window_start is None
+                or t - self._window_start >= self.suppression_interval_s):
+            self._window_start = t
+            self._type_counts = {}
+        type_ = event["type"]
+        n = self._type_counts.get(type_, 0) + 1
+        self._type_counts[type_] = n
+        if n <= self.type_budget:
+            return False
+        self.suppressed_events += 1
+        self.suppressed_by_type[type_] = (
+            self.suppressed_by_type.get(type_, 0) + 1
+        )
+        return True
+
     def emit(self, event):
         if event["severity"] < self.min_severity:
             return
-        line = json.dumps(event, separators=(",", ":"), default=repr)
+        # serialization is deferred until a file sink provably needs a
+        # line: ring-only sinks (tests, benches) skip json.dumps — a
+        # measured per-event cost at tracing-level volumes
+        line = None
+        if self._path is not None:
+            line = json.dumps(event, separators=(",", ":"), default=repr)
         with self._lock:
             if self.closed:
                 return  # interpreter teardown / explicit close: drop
+            if self._suppress_locked(event):
+                return
             if self._file is None and self._path is not None:
                 self._file = open(self._path, "a", buffering=1)
                 self._file_bytes = self._file.tell()
             if self._file is not None:
+                if line is None:  # path set concurrently with open()
+                    line = json.dumps(event, separators=(",", ":"),
+                                      default=repr)
                 data = line + "\n"
                 self._file.write(data)
                 self._file_bytes += len(data)
@@ -113,10 +171,9 @@ class TraceLog:
                         and self._file_bytes >= self.max_file_bytes):
                     self._roll_locked()
             # the ring buffer fills regardless of the file sink, so
-            # events() serves tests and forensics either way
+            # events() serves tests and forensics either way (deque
+            # maxlen: the oldest half is long gone, newest retained)
             self._buffer.append(event)
-            if len(self._buffer) > self.max_buffered:
-                del self._buffer[: self.max_buffered // 2]
 
     def events(self, type_=None):
         """Ring-buffered events (file sink or not), newest last."""
@@ -128,6 +185,12 @@ class TraceLog:
     def clear(self):
         with self._lock:
             self._buffer.clear()
+            # fresh forensics window: suppression counts restart with
+            # the buffer (cumulative suppressed_events totals remain),
+            # so back-to-back sim runs sharing the process see
+            # identical suppression decisions
+            self._type_counts = {}
+            self._window_start = None
 
 
 _global = TraceLog(

@@ -121,9 +121,13 @@ def test_e2e_line_folds_proxies_and_platform():
                 "stage_resolve_ms", "stage_apply_ms",
                 "pipeline_depth", "pipeline_depth_effective",
                 "pack_path", "pack_bytes", "pack_reuse_rate",
-                "commit_p50_ms", "commit_p99_ms", "grv_p99_ms"):
+                "commit_p50_ms", "commit_p99_ms", "grv_p99_ms",
+                "spans_sampled", "tracing_sample_rate"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
+    # tracing defaults OFF: the gauge must say so explicitly
+    assert fields["spans_sampled"] == 0
+    assert fields["tracing_sample_rate"] == 0.0
     assert fields["pipeline_depth"] >= 1
     # the cpu backend never flattens: the knob's fallback is visible
     assert fields["pack_path"] == "legacy"
@@ -150,6 +154,41 @@ def test_metrics_smoke_contract():
     from foundationdb_tpu.utils import metrics as metrics_mod
 
     assert metrics_mod.enabled()
+
+
+def test_tracing_smoke_contract():
+    """BENCH_MODE=tracing_smoke: the tracing-overhead probe emits the
+    budget fields plus the span-tree vs stage-timer critical-path
+    cross-check. One short round checks the contract; the bench run
+    owns the statistically serious comparison."""
+    out = bench.run_tracing_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "tracing_overhead_pct", "tracing_overhead_median_pct",
+                "overhead_budget_pct",
+                "within_budget", "tracing_sample_rate", "spans_sampled",
+                "spans_captured", "traces_captured", "hottest_edge",
+                "hottest_stage_spans", "hottest_stage_timers",
+                "attribution_agrees"):
+        assert key in out, key
+    assert out["metric"] == "e2e_tracing_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    assert out["tracing_sample_rate"] == 0.01
+    # the enabled arm really sampled: spans were counted and captured
+    assert out["spans_sampled"] >= 0
+    assert out["spans_captured"] >= out["traces_captured"]
+
+
+def test_tracing_smoke_spans_actually_flow():
+    """At a forced 100% sample rate even a tiny run must capture spans
+    and produce a stage attribution that matches a real stage name."""
+    out = bench.run_tracing_smoke(cpu=True, seconds=0.4, rounds=1,
+                                  rate=1.0)
+    assert out["spans_sampled"] > 0
+    assert out["spans_captured"] > 0
+    assert out["hottest_stage_spans"] in ("pack", "dispatch", "resolve",
+                                          "apply")
+    assert out["hottest_stage_timers"] in ("pack", "dispatch", "resolve",
+                                           "apply")
 
 
 def test_pack_smoke_contract():

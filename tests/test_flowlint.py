@@ -92,6 +92,33 @@ def test_fl001_suppression_on_preceding_line_honored():
     assert findings == []
 
 
+def test_fl001_flags_raw_span_id_generation():
+    """Span/trace ids must ride the deterministic seam: a raw uuid4 or
+    module-level random draw in the tracing module would make same-seed
+    sims emit divergent span streams (ISSUE 5 satellite)."""
+    findings = lint("utils/span.py", """
+        import random
+        import uuid
+
+        def new_trace_id():
+            return uuid.uuid4().int & ((1 << 64) - 1)
+
+        def new_span_id():
+            return random.getrandbits(64)
+    """)
+    assert rules_of(findings) == ["FL001", "FL001"]
+
+
+def test_fl001_span_ids_on_the_seam_pass():
+    findings = lint("utils/span.py", """
+        from foundationdb_tpu.core import deterministic
+
+        def new_span_id():
+            return deterministic.rng("span-id").getrandbits(64)
+    """)
+    assert findings == []
+
+
 # ───────────────────────────── FL002 ─────────────────────────────
 def test_fl002_flags_risky_call_before_settlement():
     findings = lint("server/foo.py", """

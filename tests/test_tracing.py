@@ -1,0 +1,508 @@
+"""Distributed tracing (utils/span.py + the instrumented commit path):
+deterministic ids, sampling, wire propagation, the connected span tree
+across every commit hop, promotion of aborted/slow unsampled traces,
+the \\xff\\xff/tracing/ special keys + fdbcli command, and the
+critical-path analysis tool."""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from foundationdb_tpu.core import deterministic  # noqa: E402
+from foundationdb_tpu.core.commit import CommitRequest  # noqa: E402
+from foundationdb_tpu.core.errors import FDBError  # noqa: E402
+from foundationdb_tpu.rpc import wire  # noqa: E402
+from foundationdb_tpu.server.cluster import Cluster  # noqa: E402
+from foundationdb_tpu.tools import tracing as tracetool  # noqa: E402
+from foundationdb_tpu.tools.cli import Cli  # noqa: E402
+from foundationdb_tpu.txn import specialkeys as sk  # noqa: E402
+from foundationdb_tpu.utils import span as span_mod  # noqa: E402
+from foundationdb_tpu.utils.trace import global_trace_log  # noqa: E402
+
+
+def _spans():
+    return global_trace_log().events("Span")
+
+
+def _tree_ok(spans):
+    """Every span shares one trace and parent links form a single tree
+    rooted at the client transaction span."""
+    assert spans, "no spans captured"
+    assert len({s["trace"] for s in spans}) == 1
+    sids = {s["sid"] for s in spans}
+    roots = [s for s in spans if s["parent"] not in sids]
+    assert [r["span"] for r in roots] == ["transaction"], roots
+    return roots[0]
+
+
+# ───────────────────────── span module unit ─────────────────────────
+def test_span_ids_ride_the_deterministic_seam():
+    try:
+        deterministic.seed("span-test")
+        a = [span_mod._new_id() for _ in range(4)]
+        deterministic.seed("span-test")
+        b = [span_mod._new_id() for _ in range(4)]
+        assert a == b
+    finally:
+        deterministic.unseed()
+
+
+def test_sampling_draws_are_seeded_and_rate_0_never_draws():
+    try:
+        deterministic.seed("sample-test")
+        a = [span_mod.should_sample(0.5) for _ in range(64)]
+        deterministic.seed("sample-test")
+        b = [span_mod.should_sample(0.5) for _ in range(64)]
+        assert a == b and any(a) and not all(a)
+        # rate 0 / 1 short-circuit without touching the stream
+        deterministic.seed("sample-test")
+        assert not span_mod.should_sample(0.0)
+        assert span_mod.should_sample(1.0)
+        assert [span_mod.should_sample(0.5) for _ in range(64)] == a
+    finally:
+        deterministic.unseed()
+
+
+def test_null_span_is_free_and_propagates_nothing():
+    n = span_mod.NULL
+    assert n.child("x") is n
+    assert n.attr(a=1) is n
+    assert n.context() is None
+    assert not n
+    n.finish()  # no-op
+
+
+def test_transaction_span_modes():
+    # off → NULL; forced → sampled; enabled-but-unsampled → NULL too
+    # (the promotion record is raw clock stamps, not span objects)
+    assert span_mod.transaction_span(0.0) is span_mod.NULL
+    sp = span_mod.transaction_span(0.0, forced=True)
+    assert sp.sampled
+    assert span_mod.transaction_span(1e-12) is span_mod.NULL
+
+
+def test_promote_lite_reconstructs_root_and_commit():
+    log = global_trace_log()
+    log.clear()
+    root = span_mod.promote_lite(1.0, 1.5, commit_begin=1.2,
+                                 error_code=1020, retries=3)
+    spans = log.events("Span")
+    names = [s["span"] for s in spans]
+    assert names == ["txn.commit", "transaction"]
+    commit, txn = spans
+    assert txn["sid"] == "%016x" % root.span_id
+    assert txn["promoted"] == 1
+    assert txn["status"] == "error" and txn["retries"] == 3
+    assert commit["parent"] == txn["sid"]
+    assert commit["error_code"] == 1020
+    assert commit["begin"] == 1.2 and commit["end"] == 1.5
+    assert txn["dur_ms"] == 500.0
+
+
+# ───────────────────────── wire propagation ─────────────────────────
+def test_commit_request_span_context_roundtrips_the_wire():
+    ctx = (0x1234, 0x5678, True)
+    r = CommitRequest(100, [], [(b"a", b"b")], [(b"c", b"d")],
+                      span_context=ctx)
+    out = wire.loads(wire.dumps(r))
+    assert out.span_context == ctx
+    # the columnar (Q) frame carries it too
+    from foundationdb_tpu.core import flatpack
+
+    wcr = [(b"k", b"k\x00")]
+    q = CommitRequest(100, [], [], wcr,
+                      flat_conflicts=flatpack.encode_conflicts([], wcr, 8),
+                      span_context=ctx)
+    out = wire.loads(wire.dumps(q))
+    assert out.span_context == ctx
+    # absent context stays absent
+    out = wire.loads(wire.dumps(CommitRequest(1, [], [], [])))
+    assert out.span_context is None
+
+
+def test_transport_request_tuple_grows_optional_tracing_frame():
+    # untraced requests keep the v4 4-tuple byte layout; a thread with
+    # an ambient context appends it as the 5th element
+    plain = wire.dumps(("q", 1, "m", (1, 2)))
+    traced = wire.dumps(("q", 1, "m", (1, 2), (7, 8, True)))
+    assert wire.loads(plain) == ("q", 1, "m", (1, 2))
+    assert wire.loads(traced)[4] == (7, 8, True)
+
+
+# ─────────────────── the connected tree, in-process ──────────────────
+def test_forced_transaction_emits_connected_tree_in_process():
+    log = global_trace_log()
+    log.clear()
+    c = Cluster(resolver_backend="cpu")
+    try:
+        db = c.database()
+        tr = db.create_transaction()
+        tr.options.set_trace()
+        tr.get(b"hop")
+        tr.set(b"hop", b"v")
+        tr.commit()
+        spans = _spans()
+        names = {s["span"] for s in spans}
+        assert {"transaction", "txn.grv", "grv.grant", "txn.read",
+                "txn.commit", "proxy.batch", "resolver.scan",
+                "tlog.push", "storage.apply"} <= names
+        root = _tree_ok(spans)
+        assert root["status"] == "committed"
+        # the batch span links its member commit span
+        batch = next(s for s in spans if s["span"] == "proxy.batch")
+        commit = next(s for s in spans if s["span"] == "txn.commit")
+        assert commit["sid"] in batch["links"]
+        assert batch["parent"] == commit["sid"]
+    finally:
+        c.close()
+
+
+def test_untraced_transactions_emit_nothing():
+    log = global_trace_log()
+    log.clear()
+    c = Cluster(resolver_backend="cpu")  # tracing_sample_rate = 0.0
+    try:
+        db = c.database()
+        db.set(b"quiet", b"v")
+        assert db.get(b"quiet") == b"v"
+        assert _spans() == []
+        tr = db.create_transaction()
+        tr.set(b"quiet2", b"v")
+        assert tr._trace_span() is span_mod.NULL  # the cheap off path
+        tr.commit()
+        assert _spans() == []
+    finally:
+        c.close()
+
+
+# ─────────────── the connected tree, over the real wire ──────────────
+def test_remote_traced_commit_yields_full_span_tree(tmp_path):
+    """The acceptance tree: a traced client commit against a served
+    fdbserver crosses the wire (protocol v5 tracing frames +
+    CommitRequest.span_context) and yields ONE connected tree holding
+    client, grv, proxy-batch, pipeline-stage, resolver, tlog, and
+    storage spans. Concurrent untraced commits ride along so the
+    server batcher forms a real multi-chunk backlog group — the
+    pipelined path whose pack/dispatch/resolve/apply stage spans
+    mirror StageStats."""
+    import threading
+
+    from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+
+    log = global_trace_log()
+    cluster = Cluster(commit_pipeline="thread", resolver_backend="cpu",
+                      commit_pipeline_depth=2, commit_batch_max=2,
+                      commit_batch_interval_s=0.05)
+    server = serve_cluster(cluster)
+    rc = None
+    need = {"transaction", "txn.grv", "grv.grant", "txn.read",
+            "txn.commit", "proxy.batch", "stage.pack", "stage.dispatch",
+            "stage.resolve", "stage.apply", "resolver.scan",
+            "tlog.push", "storage.apply"}
+    try:
+        rc = RemoteCluster([server.address])
+        db = rc.database()
+        for attempt in range(5):
+            log.clear()
+
+            def traced():
+                tr = db.create_transaction()
+                tr.options.set_trace()
+                tr.get(b"remote-hop")
+                tr.set(b"remote-hop", b"v%d" % attempt)
+                tr.commit()
+
+            def plain(i):
+                tr = db.create_transaction()
+                tr.set(b"filler%d" % i, b"v")
+                tr.commit()
+
+            # the traced commit leads; fillers pile into the batcher's
+            # 50ms window behind it, forming a >1-chunk backlog group
+            ts = [threading.Thread(target=traced)] + [
+                threading.Thread(target=plain, args=(i,))
+                for i in range(7)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if need <= {s["span"] for s in _spans()}:
+                    break
+                time.sleep(0.02)
+            if need <= {s["span"] for s in _spans()}:
+                break
+        spans = _spans()
+        assert need <= {s["span"] for s in spans}, (
+            need - {s["span"] for s in spans}
+        )
+        _tree_ok(spans)
+        # the critical-path tool agrees this is one tree with the
+        # stage split present
+        rep = tracetool.report(spans)
+        assert rep["traces"] == 1
+        assert rep["hottest_stage"] in ("pack", "dispatch", "resolve",
+                                        "apply")
+    finally:
+        if rc is not None:
+            rc.close()
+        server.close()
+        cluster.close()
+
+
+# ───────────────────── promotion (abort / slow) ──────────────────────
+def test_aborted_unsampled_commit_promotes_buffered_spans():
+    log = global_trace_log()
+    log.clear()
+    c = Cluster(resolver_backend="cpu", tracing_sample_rate=1e-12)
+    try:
+        db = c.database()
+        db.set(b"pk", b"0")
+        t1 = db.create_transaction()
+        t1.get(b"pk")  # read conflict range
+        t2 = db.create_transaction()
+        t2.set(b"pk", b"1")
+        t2.commit()  # may promote via slow-commit; filter by status
+        log.clear()
+        t1.set(b"pk", b"2")
+        try:
+            t1.commit()
+            raise AssertionError("expected not_committed")
+        except FDBError as e:
+            assert e.code == 1020
+        spans = _spans()
+        root = next(s for s in spans if s["span"] == "transaction")
+        assert root["status"] == "error"
+        commit = next(s for s in spans if s["span"] == "txn.commit")
+        assert commit["error_code"] == 1020
+    finally:
+        c.close()
+
+
+def test_slow_commit_window_promotion_threshold():
+    """Slow-commit promotion is per WINDOW (the batcher/proxy's
+    existing commit_e2e stamps — zero extra hot-path clock reads): a
+    window outliving tracing_slow_commit_ms emits a commit.window
+    span; under the threshold nothing emits for unsampled traffic."""
+    c = Cluster(resolver_backend="cpu", tracing_sample_rate=1e-12,
+                tracing_slow_commit_ms=0.0)
+    try:
+        log = global_trace_log()
+        log.clear()
+        c.database().set(b"slow", b"v")  # every window counts as slow
+        wins = [s for s in _spans() if s["span"] == "commit.window"]
+        assert wins and wins[0]["promoted"] == 1 and wins[0]["txns"] == 1
+    finally:
+        c.close()
+    # and with a huge threshold, an unsampled success stays silent
+    c = Cluster(resolver_backend="cpu", tracing_sample_rate=1e-12,
+                tracing_slow_commit_ms=1e12)
+    try:
+        log = global_trace_log()
+        log.clear()
+        c.database().set(b"fast", b"v")
+        assert _spans() == []
+    finally:
+        c.close()
+
+
+# ─────────────── special keys + fdbcli tracing command ───────────────
+def test_tracing_special_keys_read_and_configure():
+    c = Cluster(resolver_backend="cpu")
+    try:
+        db = c.database()
+        tr = db.create_transaction()
+        assert tr.get(sk.TRACING_ENABLED) == b"0"
+        assert tr.get(sk.TRACING_TOKEN) == b"0"
+        # range read materializes the module rows
+        rows = dict(tr.get_range(sk.TRACING, sk.TRACING + b"\xff"))
+        assert sk.TRACING_RATE in rows and sk.TRACING_ENABLED in rows
+        # write the rate; applied at commit
+        tr.set(sk.TRACING_RATE, b"0.25")
+        # RYW: the pending write is visible before commit
+        assert tr.get(sk.TRACING_RATE) == b"0.25"
+        tr.commit()
+        assert c.tracing_config()["sample_rate"] == 0.25
+        assert c.tracing_config()["enabled"]
+        # enabled=0 turns it off
+        tr = db.create_transaction()
+        tr.set(sk.TRACING_ENABLED, b"0")
+        tr.commit()
+        assert c.tracing_config()["sample_rate"] == 0.0
+    finally:
+        c.close()
+
+
+def test_tracing_token_forces_sampling_per_transaction():
+    log = global_trace_log()
+    log.clear()
+    c = Cluster(resolver_backend="cpu")  # tracing globally OFF
+    try:
+        db = c.database()
+        tr = db.create_transaction()
+        tr.set(sk.TRACING_TOKEN, b"1")  # txn-local force
+        tr.set(b"tok", b"v")
+        assert tr.get(sk.TRACING_TOKEN) != b"0"
+        tr.commit()
+        spans = _spans()
+        assert any(s["span"] == "transaction" for s in spans)
+        # the next transaction is untraced again
+        log.clear()
+        db.set(b"tok2", b"v")
+        assert _spans() == []
+    finally:
+        c.close()
+
+
+def test_cli_tracing_command(tmp_path):
+    import io
+
+    c = Cluster(resolver_backend="cpu")
+    try:
+        out = io.StringIO()
+        cli = Cli(c.database(), out=out)
+        cli.run_command("tracing status")
+        assert "Tracing: off" in out.getvalue()
+        cli.run_command("tracing on")
+        assert c.tracing_config() == {
+            "enabled": True,
+            "sample_rate": Cluster.TRACING_DEFAULT_RATE,
+            "slow_commit_ms": c.knobs.tracing_slow_commit_ms,
+        }
+        cli.run_command("tracing sample 0.5")
+        assert c.tracing_config()["sample_rate"] == 0.5
+        out2 = io.StringIO()
+        Cli(c.database(), out=out2).run_command("tracing status")
+        assert "Tracing: on" in out2.getvalue()
+        assert "0.5" in out2.getvalue()
+        cli.run_command("tracing off")
+        assert not c.tracing_config()["enabled"]
+    finally:
+        c.close()
+
+
+def test_remote_tracing_config_roundtrip():
+    from foundationdb_tpu.rpc.service import RemoteCluster, serve_cluster
+
+    cluster = Cluster(resolver_backend="cpu")
+    server = serve_cluster(cluster)
+    rc = None
+    try:
+        rc = RemoteCluster([server.address])
+        assert not rc.tracing_config()["enabled"]
+        _ = rc.knobs  # populate the client-side knob cache
+        rc.set_tracing(enabled=True)
+        # the knob cache was invalidated: new transactions sample
+        assert rc.knobs.tracing_sample_rate == \
+            Cluster.TRACING_DEFAULT_RATE
+        assert rc.tracing_config()["enabled"]
+    finally:
+        if rc is not None:
+            rc.close()
+        server.close()
+        cluster.close()
+
+
+# ──────────────── same-seed sims: byte-identical spans ───────────────
+def _sim_span_stream(seed, datadir):
+    from foundationdb_tpu.sim.simulation import Simulation
+    from foundationdb_tpu.sim.workloads import cycle_setup, cycle_workload
+
+    log = global_trace_log()
+    log.clear()
+    sim = Simulation(seed=seed, buggify=True, crash_p=0.0,
+                     datadir=datadir, tracing_sample_rate=0.5)
+    try:
+        cycle_setup(sim.db, 8)
+        for a in range(3):
+            sim.add_workload(
+                f"c{a}",
+                cycle_workload(sim.db, 8, 10, random.Random(seed * 7 + a)),
+            )
+        sim.run()
+        return "\n".join(
+            json.dumps(e, sort_keys=False, default=repr)
+            for e in log.events("Span")
+        )
+    finally:
+        sim.close()
+        deterministic.unseed()
+        deterministic.registry().reset_clock()
+
+
+def test_same_seed_sims_emit_byte_identical_span_streams(tmp_path):
+    s1 = _sim_span_stream(1234, str(tmp_path / "s1"))
+    s2 = _sim_span_stream(1234, str(tmp_path / "s2"))
+    assert s1 == s2
+    assert s1, "the sims emitted no spans at a 0.5 sample rate"
+    # sampling really is a partition: some txns traced, ids present
+    first = json.loads(s1.splitlines()[0])
+    assert set(first) >= {"span", "trace", "sid", "parent", "begin",
+                          "end", "dur_ms"}
+
+
+# ───────────────────── critical-path analysis tool ───────────────────
+def _mk(span, trace, sid, parent, dur):
+    return {"type": "Span", "span": span, "trace": trace, "sid": sid,
+            "parent": parent, "begin": 0.0, "end": dur / 1e3,
+            "dur_ms": dur}
+
+
+def test_critical_path_report_hottest_edge_and_stage():
+    t = "t" * 16
+    spans = [
+        _mk("transaction", t, "r", "0" * 16, 10.0),
+        _mk("txn.commit", t, "c", "r", 9.0),
+        _mk("stage.pack", t, "p", "c", 1.0),
+        _mk("stage.resolve", t, "q", "c", 6.0),
+        _mk("stage.apply", t, "a", "c", 2.0),
+        _mk("tlog.push", t, "l", "a", 0.5),
+    ]
+    rep = tracetool.report(spans)
+    assert rep["traces"] == 1 and rep["spans"] == 6
+    assert rep["hottest_stage"] == "resolve"
+    # edges attribute parent→child totals; roots form no edge
+    assert rep["hottest_edge"] == "transaction->txn.commit"
+    assert rep["hottest_edge_total_ms"] == 9.0
+    assert rep["hops"]["stage.resolve"]["count"] == 1
+    # self time: txn.commit spent 9 - (1 + 6 + 2) = 0 exclusive;
+    # stage.apply spent 2 - 0.5 = 1.5 outside its tlog push
+    assert rep["hops"]["txn.commit"]["self_ms"] == 0.0
+    assert rep["hops"]["stage.apply"]["self_ms"] == 1.5
+    assert rep["slowest_trace"]["root"] == "transaction"
+    assert rep["slowest_trace"]["dur_ms"] == 10.0
+
+
+def test_critical_path_tool_reads_trace_files(tmp_path):
+    path = tmp_path / "trace.json"
+    t = "a" * 16
+    events = [
+        _mk("transaction", t, "r", "0" * 16, 4.0),
+        _mk("txn.commit", t, "c", "r", 3.0),
+    ]
+    with open(path, "w") as f:
+        f.write("not json\n")
+        f.write(json.dumps({"type": "Other", "x": 1}) + "\n")
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    spans = tracetool.load_spans([str(path)])
+    assert len(spans) == 2
+    rep = tracetool.report(spans)
+    assert rep["hottest_edge"] == "transaction->txn.commit"
+
+
+def test_status_exposes_trace_section():
+    c = Cluster(resolver_backend="cpu")
+    try:
+        doc = c.status()["cluster"]["trace"]
+        assert "suppressed_events" in doc
+        assert "spans_sampled" in doc
+        assert doc["tracing"]["enabled"] is False
+    finally:
+        c.close()
