@@ -456,7 +456,8 @@ def measure_kernel_step_ms(ck, params, batch, n_short=8, n_long=40,
 
 
 def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
-            n_proxies=None, tracing_sample_rate=None):
+            n_proxies=None, tracing_sample_rate=None,
+            batch_scheduling=None, txn_repair=None, retry_mode=None):
     """End-to-end committed txns/sec: N client threads driving pipelined
     commits through the full live pipeline — Transaction → batching
     commit proxy (shared-version batches) → TPU resolver → tlog →
@@ -506,6 +507,27 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     # line either way so the artifact shows whether tracing was live
     if tracing_sample_rate is None:
         tracing_sample_rate = float(env("BENCH_TRACING_RATE", 0.0))
+    # conflict management (ISSUE 6): proxy-side abort-aware batch
+    # scheduling + client-side transaction repair — both default off
+    # (the measured restart-only baseline); the repair_smoke probe and
+    # the tpcc_repair config turn them on together
+    sched_on = (batch_scheduling if batch_scheduling is not None
+                else env("BENCH_E2E_SCHED", "0") == "1")
+    repair_on = (txn_repair if txn_repair is not None
+                 else env("BENCH_E2E_REPAIR", "0") == "1")
+    repair_rounds = int(env("BENCH_E2E_REPAIR_ROUNDS", 2))
+    # what a conflicted txn costs the client (BENCH_E2E_RETRY):
+    #   discard — count the abort and move on (the historical baseline:
+    #             a conflict is free, which no real application gets);
+    #   cold    — the standard restart protocol: tr.on_error backoff
+    #             sleep + full re-read + resubmit, bounded rounds;
+    #   repair  — txn/repair.py: read version moved to the rejecting
+    #             commit version, verified-cache reads, no backoff.
+    # cold/repair both retry-until-committed (bounded), so their
+    # committed tx/s is completion GOODPUT — comparable arms.
+    if retry_mode is None:
+        retry_mode = env("BENCH_E2E_RETRY",
+                         "repair" if repair_on else "discard")
     cluster = Cluster(
         commit_pipeline="thread",
         resolver_backend=backend,
@@ -516,6 +538,8 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         range_ring_capacity=4096 if not cpu else 256,
         commit_batch_max=1024 if not cpu else 128,
         tracing_sample_rate=tracing_sample_rate,
+        commit_batch_scheduling=sched_on,
+        txn_repair=repair_on,
         # bounded multi-stage commit pipeline (server/batcher.py):
         # pack+resolve of group N+1 overlaps the apply of group N
         commit_pipeline_depth=int(env("BENCH_PIPELINE_DEPTH", 2)),
@@ -604,22 +628,63 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         districts = zipfian_sampler(n_districts, tpcc_theta, rng)(16384)
         rng_state = (ids, is_rmw, districts)
         j = 0
+        # retry backlog for the non-discard modes: (due_window, tr,
+        # builder index, retry round). Repaired txns re-enter SPACED
+        # (due = now + 2^round windows) — the hot-key retries of one
+        # conflict otherwise resubmit together and re-collide as a
+        # clique; spacing in WINDOWS is free precisely because repair
+        # doesn't sleep, while the cold arm's spacing is the backoff
+        # sleep the standard protocol itself imposes.
+        backlog = []
+        wi = 0
         try:
             while not stop.is_set():
-                trs, futs = [], []
-                for _ in range(window):
+                wi += 1
+                pending = []  # (tr, fut, builder index, retry round)
+                if backlog:
+                    # admit at most half a window of retries: fresh
+                    # (usually colder-key) work must never starve
+                    # behind a hot-key retry backlog
+                    due = [b for b in backlog
+                           if b[0] <= wi][:max(1, window // 2)]
+                    if due:
+                        backlog = [b for b in backlog if b not in due]
+                        for _, tr, tj, k in due:
+                            pending.append((tr, tr.commit_async(), tj, k))
+                for _ in range(window - len(pending)):
                     tr = db.create_transaction()
                     build_txn(tr, rng_state, j)
+                    pending.append((tr, tr.commit_async(), j, 0))
                     j += 1
-                    trs.append(tr)
-                    futs.append(tr.commit_async())
-                for tr, fut in zip(trs, futs):
+                for tr, fut, tj, k in pending:
                     fut.result(timeout=60)
                     try:
                         tr.commit_finish(fut)
                         committed[cid] += 1
                     except FDBError as e:
-                        if e.code in (1020, 1021):
+                        if e.code == 1020 and retry_mode != "discard" \
+                                and k < repair_rounds:
+                            conflicts[cid] += 1
+                            if retry_mode == "repair":
+                                # txn/repair.py: rv moved to the
+                                # rejecting commit version, conflicting
+                                # keys refreshed, no GRV, no sleep; a
+                                # value-dependent repair re-runs the
+                                # builder against the verified cache
+                                if not tr.try_repair(e):
+                                    continue  # no repair basis: drop
+                                if not tr.repair_ready:
+                                    build_txn(tr, rng_state, tj)
+                                backlog.append((wi + (1 << k), tr, tj,
+                                                k + 1))
+                            else:  # cold: the standard restart
+                                # protocol — on_error backoff sleep,
+                                # reset, fresh GRV, full re-read (the
+                                # sleep IS its retry spacing)
+                                tr.on_error(e)
+                                build_txn(tr, rng_state, tj)
+                                backlog.append((wi, tr, tj, k + 1))
+                        elif e.code in (1020, 1021):
                             conflicts[cid] += 1
                         else:
                             raise
@@ -676,6 +741,18 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "e2e_committed_txns": total,
         "e2e_conflict_rate": round(aborted / max(total + aborted, 1), 4),
         "e2e_backlog_target": getattr(bp, "_backlog_target", 1),
+        # conflict management (ISSUE 6): whether repair/scheduling ran,
+        # and the repair outcomes from the proxy registry rollups —
+        # repair_rate is the share of committed txns a repair saved
+        # (the scheduler's reordered/deferred ride stage_summary below)
+        "e2e_repair_enabled": repair_on,
+        "e2e_sched_enabled": sched_on,
+        "e2e_retry_mode": retry_mode,
+        "repair_attempts": roll.get("repair_attempts", 0),
+        "repair_commits": roll.get("repair_commits", 0),
+        "repair_fallbacks": roll.get("repair_fallbacks", 0),
+        "repair_rate": round(
+            roll.get("repair_commits", 0) / max(total, 1), 4),
         # distributed tracing: how many transactions carried a sampled
         # trace this run (0 when the knob is off — the field rides
         # every line so its absence is never ambiguous)
@@ -1592,6 +1669,80 @@ def run_tracing_smoke(cpu, seconds=None, rounds=None, rate=None):
     }
 
 
+def run_repair_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=repair_smoke: the conflict-management subsystem's
+    goodput probe — the contended tpcc e2e with transaction repair +
+    abort-aware batch scheduling ON vs the restart-only baseline,
+    interleaved pairs, median committed tx/s each (the same drift-
+    cancelling protocol as metrics_smoke). The ISSUE-6 acceptance ask
+    is ≥3x committed tx/s on this shape; ``speedup_repair`` is that
+    number, measured, and the enabled arm's repair/scheduler counters
+    ride along so the artifact shows the subsystem actually engaged."""
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    backend = "native"
+    runs = {True: [], False: []}
+    fields = {True: None, False: None}
+    discard_tps = None
+    for i in range(rounds):
+        arms = [(False, "cold"), (True, "repair")]
+        if i == 0:
+            # one reference arm: the historical discard client (count
+            # the abort, issue fresh work — "conflicts are free", which
+            # no application that must complete its txns actually gets)
+            arms.insert(0, (False, "discard"))
+        for on, rmode in arms:
+            # completion goodput on the paired arms: every conflicted
+            # txn retries until committed (bounded rounds) — cold
+            # through the standard restart protocol (on_error backoff
+            # + fresh GRV + full re-read), repair through the
+            # conflict-management subsystem. Interleaved pairs, median
+            # compare (the metrics_smoke drift protocol).
+            kw = {"mode": "tpcc", "seconds": secs,
+                  "batch_scheduling": on, "txn_repair": on,
+                  "retry_mode": rmode}
+            try:
+                r = run_e2e(cpu, backend=backend, **kw)
+            except Exception as e:
+                sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                backend = "cpu"
+                r = run_e2e(cpu, backend=backend, **kw)
+            if rmode == "discard":
+                discard_tps = r["e2e_committed_txns_per_sec"]
+                continue
+            runs[on].append(r["e2e_committed_txns_per_sec"])
+            fields[on] = r
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    on_f = fields[True]
+    return {
+        "metric": "e2e_repair_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "restart_only_txns_per_sec": round(v_off, 1),
+        "discard_txns_per_sec": discard_tps,
+        "speedup_repair": round(v_on / max(v_off, 1e-9), 3),
+        "conflict_rate_on": on_f.get("e2e_conflict_rate"),
+        "conflict_rate_off": fields[False].get("e2e_conflict_rate"),
+        "repair_rate": on_f.get("repair_rate"),
+        "repair_attempts": on_f.get("repair_attempts"),
+        "repair_commits": on_f.get("repair_commits"),
+        "repair_fallbacks": on_f.get("repair_fallbacks"),
+        "sched_batches": on_f.get("sched_batches"),
+        "sched_reordered": on_f.get("sched_reordered"),
+        "sched_deferred": on_f.get("sched_deferred"),
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": on_f.get("platform"),
+        "commit_p50_ms": on_f.get("commit_p50_ms"),
+        "commit_p99_ms": on_f.get("commit_p99_ms"),
+    }
+
+
 def _compact_summary(out, configs):
     """The FINAL stdout line, guaranteed to fit the driver's ~2KB
     stdout-tail capture (VERDICT r4 weak #1: the folded rich headline
@@ -1616,7 +1767,8 @@ def _compact_summary(out, configs):
               "stage_pack_ms", "stage_dispatch_ms", "stage_resolve_ms",
               "stage_apply_ms",
               "pipeline_depth_effective", "pack_path", "pack_bytes",
-              "pack_reuse_rate", "spans_sampled", "flowlint_findings",
+              "pack_reuse_rate", "spans_sampled", "repair_rate",
+              "flowlint_findings",
               "tpu_recovered", "fallback_from", "error"):
         if out.get(k) is not None:
             line[k] = out[k]
@@ -1650,6 +1802,8 @@ def main():
     # disabled ycsb e2e, ≤2% budget) | tracing_smoke (distributed-
     # tracing overhead at the default 1% sample rate, ≤2% budget, plus
     # span-tree vs stage-timer critical-path cross-check) |
+    # repair_smoke (conflict repair + abort-aware scheduling vs the
+    # restart-only baseline on the contended tpcc shape) |
     # sharded_e2e (internal: the multilane re-exec child)
     # only the default multi-config run plans recovery re-execs, so only
     # it earns the wider deadline (worst case 60+500+120+650s of
@@ -1738,6 +1892,14 @@ def main():
         # same contract as metrics_smoke: the ≤2% budget is a GATE
         if not out["within_budget"]:
             sys.exit(1)
+        return
+
+    if mode == "repair_smoke":
+        # conflict repair + batch scheduling vs restart-only on the
+        # contended tpcc shape (interleaved pairs, median compare)
+        out = run_repair_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
         return
 
     if mode == "pack_smoke":
@@ -1871,6 +2033,16 @@ def main():
         # BASELINE config 4: TPC-C-shaped hot-district contention
         _fold("tpcc", _e2e_line(cpu, "e2e_committed_txns_per_sec_tpcc",
                                 mode="tpcc", seconds=secondary_s), E2E_KEYS)
+        # the same shape with the conflict-management subsystem ON
+        # (ISSUE 6): transaction repair + abort-aware batch scheduling
+        # turn the abort churn into goodput — the ≥3x-vs-tpcc target
+        _fold("tpcc_repair",
+              _e2e_line(cpu, "e2e_committed_txns_per_sec_tpcc_repair",
+                        mode="tpcc", seconds=secondary_s,
+                        batch_scheduling=True, txn_repair=True),
+              E2E_KEYS + ("e2e_retry_mode", "repair_rate",
+                          "repair_commits", "repair_fallbacks",
+                          "sched_reordered", "sched_deferred"))
         # BASELINE config 5: sharded resolvers — the mesh fleet. On a
         # CPU host the in-process mesh degenerates to one lane, so
         # re-exec under a forced 4-device virtual mesh for real lanes.

@@ -49,6 +49,9 @@ class CommitRequest:
 
     @property
     def read_conflict_ranges(self):
+        # memoized on the request: the flat-path decode runs at most
+        # once per side, so the repair engine's (and the scheduler's)
+        # repeated access never re-parses the blobs
         r = self._read_conflict_ranges
         if r is None:
             r = self._read_conflict_ranges = self._from_flat("read")

@@ -183,10 +183,11 @@ class FlatTxnBatch:
     blobs)."""
 
     __slots__ = ("num_limbs", "rv", "prc", "pwc", "rrc", "rwc",
-                 "pr_blob", "pw_blob", "rr_blob", "rw_blob")
+                 "pr_blob", "pw_blob", "rr_blob", "rw_blob", "_txn_memo")
 
     def __init__(self, num_limbs, rv, prc, pwc, rrc, rwc,
                  pr_blob, pw_blob, rr_blob, rw_blob):
+        self._txn_memo = {}  # i -> decoded TxnRequest (see __getitem__)
         self.num_limbs = num_limbs
         self.rv = rv  # int64[n] absolute read versions
         self.prc = prc  # int64[n] point-read counts
@@ -215,6 +216,12 @@ class FlatTxnBatch:
     # ── fallback decode (rare: lane overflow, too-old txns,
     #    report_conflicting_keys) ──
     def __getitem__(self, i):
+        memo = self._txn_memo.get(i)
+        if memo is not None:
+            # per-txn decode memo: report_conflicting_keys (and the
+            # repair engine's repeated access behind it) hits each
+            # failed index more than once — never re-parse the blobs
+            return memo
         from foundationdb_tpu.resolver.skiplist import TxnRequest
 
         W4 = entry_width(self.num_limbs)
@@ -234,11 +241,12 @@ class FlatTxnBatch:
                          self.rw_blob[ro[1] * 2 * W4:
                                       (ro[1] + int(self.rwc[i])) * 2 * W4],
                          self.num_limbs)
-        return TxnRequest(
+        out = self._txn_memo[i] = TxnRequest(
             read_version=int(self.rv[i]),
             point_reads=pr, point_writes=pw,
             range_reads=rr, range_writes=rw,
         )
+        return out
 
     def to_txn_requests(self):
         """The whole batch as legacy TxnRequests (the rare-path escape

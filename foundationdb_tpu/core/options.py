@@ -38,6 +38,25 @@ class Knobs:
     # (tests/test_packing_flat.py).
     commit_pack_path: str = "flat"
 
+    # --- conflict repair & abort-aware batch scheduling ---
+    # proxy-side intra-batch scheduling (server/scheduler.py): reorder a
+    # commit batch host-side — over the clients' already-encoded flat
+    # limb blobs, before packing — so reads resolve before the writes
+    # they overlap and the resolver sees fewer self-inflicted aborts.
+    # Default off: arrival order is the measured baseline.
+    commit_batch_scheduling: bool = False
+    # client-side transaction repair (txn/repair.py): on not_committed
+    # with conflicting-key info, re-read ONLY the conflicting keys at
+    # the failed batch's commit version and either replay the recorded
+    # op log (read-set digest match — a spurious conflict) or fall back
+    # to the retry loop seeded with the verified read cache. Default
+    # off: the restart-from-scratch loop is the baseline.
+    txn_repair: bool = False
+    # consecutive repair rounds before a conflicted transaction falls
+    # back to the full cold restart (fresh GRV + backoff sleep) — the
+    # livelock bound on the no-backoff repair retry
+    txn_repair_max_rounds: int = 4
+
     # --- versions / MVCC ---
     versions_per_second: int = 1_000_000
     max_read_transaction_life_versions: int = 5_000_000

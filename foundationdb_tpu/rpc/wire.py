@@ -23,7 +23,10 @@ from foundationdb_tpu.core.mutations import Mutation, Op
 # v5: distributed tracing — an optional SpanContext frame on requests
 #     (transport appends it to the "q" tuple; absent = untraced) and a
 #     trailing span_context value on both CommitRequest frames
-PROTOCOL_VERSION = 5
+# v6: conflict repair — a trailing conflict_version on the FDBError
+#     frame (the commit version whose writes rejected a reporting txn;
+#     the client repair engine re-reads its conflicting keys there)
+PROTOCOL_VERSION = 6
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -128,6 +131,9 @@ def _enc(buf, v):
         buf.append(struct.pack(">I", v.code))
         # optional conflicting-keys payload (report_conflicting_keys)
         _enc(buf, getattr(v, "conflicting_key_ranges", None))
+        # v6: the rejecting commit version (conflict repair's read
+        # version); N for errors that carry no conflict report
+        _enc(buf, getattr(v, "conflict_version", None))
     else:
         raise TypeError(f"wire: cannot encode {type(v).__name__}: {v!r}")
 
@@ -230,6 +236,9 @@ def _dec(r: _Reader):
         ranges = _dec(r)
         if ranges is not None:
             e.conflicting_key_ranges = ranges
+        cv = _dec(r)
+        if cv is not None:
+            e.conflict_version = cv
         return e
     raise ValueError(f"wire: unknown tag {tag!r}")
 

@@ -375,6 +375,12 @@ class BatchingCommitProxy:
         )
         out["pack_flat_batches"] = flat
         out["pack_legacy_batches"] = legacy
+        # abort-aware batch scheduling decisions (server/scheduler.py):
+        # zero across the board when the knob is off — the fields ride
+        # anyway so a bench line always states whether scheduling ran
+        out["sched_batches"] = getattr(inner, "sched_batches", 0)
+        out["sched_reordered"] = getattr(inner, "sched_reordered_total", 0)
+        out["sched_deferred"] = getattr(inner, "sched_deferred_total", 0)
         out["pack_bytes"] = round(
             getattr(inner, "pack_bytes_total", 0) / max(flat, 1)
         )

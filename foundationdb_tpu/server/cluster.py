@@ -914,6 +914,21 @@ class Cluster:
                 "hottest_stage_totals_s": {
                     k: round(v, 6) for k, v in stage_totals.items()
                 },
+                # conflict repair + abort-aware scheduling outcomes
+                # (txn/repair.py, server/scheduler.py): counted on the
+                # commit-proxy registries — client repairs land on the
+                # registry of the proxy the client talks to, scheduler
+                # decisions on the proxy that reordered the batch
+                "repair_attempts": self._sum_counter(
+                    "commit_proxy", "repair_attempts"),
+                "repair_commits": self._sum_counter(
+                    "commit_proxy", "repair_commits"),
+                "repair_fallbacks": self._sum_counter(
+                    "commit_proxy", "repair_fallbacks"),
+                "sched_reordered": self._sum_counter(
+                    "commit_proxy", "sched_reordered"),
+                "sched_deferred": self._sum_counter(
+                    "commit_proxy", "sched_deferred"),
             },
             "commit_latency_bands": commit,
             "grv_latency_bands": grv,

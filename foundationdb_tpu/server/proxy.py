@@ -74,11 +74,14 @@ class _PipelinedGroup:
 
     __slots__ = ("request_batches", "metas", "handle", "first_prev",
                  "last_cv", "granted", "results_list", "error",
-                 "resolve_s", "apply_s", "trace_ctx")
+                 "resolve_s", "apply_s", "trace_ctx", "plans")
 
     def __init__(self, request_batches):
         self.request_batches = request_batches
         self.metas = None
+        # per-batch SchedulePlans (abort-aware scheduling): finish maps
+        # position-ordered results back to request order through these
+        self.plans = None
         self.handle = None
         self.first_prev = self.last_cv = None
         self.granted = False
@@ -130,6 +133,15 @@ class CommitProxy:
         self.pack_flat_batches = 0
         self.pack_legacy_batches = 0
         self.pack_bytes_total = 0
+        # abort-aware batch scheduling (server/scheduler.py, knob
+        # commit_batch_scheduling): plain totals ride stage_summary /
+        # bench lines even with the metrics kill switch off; the
+        # registry counters feed status rollups
+        self.sched_batches = 0
+        self.sched_reordered_total = 0
+        self.sched_deferred_total = 0
+        self._m_sched_reordered = self.metrics.counter("sched_reordered")
+        self._m_sched_deferred = self.metrics.counter("sched_deferred")
         # Concurrent client threads may drive the synchronous proxy
         # directly (no batching wrapper): the pipeline mutates shared
         # state (donated resolver buffers, tlog order, storage overlay),
@@ -431,6 +443,9 @@ class CommitProxy:
                 for _ in requests
             ]
         window = max(0, cv - self.knobs.max_read_transaction_life_versions)
+        # past every admission gate: reorder for fewer self-inflicted
+        # aborts (results are mapped back to request order at return)
+        requests, plan = self._maybe_schedule(requests)
         try:
             txns = self._build_txns(requests)
         except BaseException:
@@ -472,8 +487,10 @@ class CommitProxy:
             # log-gate turn is still owed
             self._skip_turns_quiet(prev, cv)
             raise
-        return self._finalize_batch(requests, txns, statuses, cv, window,
-                                    prev, traced=rctx is not None)
+        results = self._finalize_batch(requests, txns, statuses, cv,
+                                       window, prev,
+                                       traced=rctx is not None, plan=plan)
+        return plan.restore(results) if plan is not None else results
 
     def _resolve_ordered(self, txns, cv, window, prev):
         """Resolution in global version order: conflict history is
@@ -618,10 +635,13 @@ class CommitProxy:
         first_prev, last_cv = pairs[0][0], pairs[-1][1]
         try:
             metas = []
+            plans = []
             for reqs, (prev, cv) in zip(request_batches, pairs):
                 window = max(
                     0, cv - self.knobs.max_read_transaction_life_versions
                 )
+                reqs, plan = self._maybe_schedule(reqs)
+                plans.append(plan)
                 metas.append((reqs, self._build_txns(reqs), cv, window))
         except BaseException:
             # grant made, gates untouched: consume the whole span's
@@ -663,13 +683,15 @@ class CommitProxy:
         if self.log_gate is not None:
             self.log_gate.enter(first_prev)
         try:
-            return [
-                self._finalize_batch(reqs, txns, statuses, cv, window,
-                                     prev=None,
-                                     traced=gctx is not None)
-                for (reqs, txns, cv, window), statuses
-                in zip(metas, statuses_list)
-            ]
+            out = []
+            for (reqs, txns, cv, window), statuses, plan in zip(
+                    metas, statuses_list, plans):
+                res = self._finalize_batch(reqs, txns, statuses, cv,
+                                           window, prev=None,
+                                           traced=gctx is not None,
+                                           plan=plan)
+                out.append(plan.restore(res) if plan is not None else res)
+            return out
         finally:
             if self.log_gate is not None:
                 self.log_gate.advance(last_cv)
@@ -753,11 +775,15 @@ class CommitProxy:
         group.granted = True
         try:
             metas = []
+            plans = []
             for reqs, (prev, cv) in zip(request_batches, pairs):
                 window = max(
                     0, cv - self.knobs.max_read_transaction_life_versions
                 )
+                reqs, plan = self._maybe_schedule(reqs)
+                plans.append(plan)
                 metas.append((reqs, self._build_txns(reqs), cv, window))
+            group.plans = plans
         except BaseException as e:
             group.error = e
             group.results_list = err_1021()
@@ -863,14 +889,16 @@ class CommitProxy:
                     for reqs in group.request_batches
                 ]
             try:
-                return [
-                    self._finalize_batch(reqs, txns, statuses, cv, window,
-                                         prev=None,
-                                         traced=group.trace_ctx
-                                         is not None)
-                    for (reqs, txns, cv, window), statuses
-                    in zip(group.metas, statuses_list)
-                ]
+                out = []
+                for (reqs, txns, cv, window), statuses, plan in zip(
+                        group.metas, statuses_list,
+                        group.plans or [None] * len(group.metas)):
+                    res = self._finalize_batch(
+                        reqs, txns, statuses, cv, window, prev=None,
+                        traced=group.trace_ctx is not None, plan=plan)
+                    out.append(
+                        plan.restore(res) if plan is not None else res)
+                return out
             finally:
                 if self.log_gate is not None:
                     self.log_gate.advance(group.last_cv)
@@ -894,6 +922,29 @@ class CommitProxy:
         return flatpack.build_flat_batch(
             requests, self.knobs.key_limbs, self._idmp_point
         )
+
+    def _maybe_schedule(self, requests):
+        """Abort-aware intra-batch scheduling (server/scheduler.py):
+        reorder the batch host-side — over the clients' already-encoded
+        flat limb blobs, before any packing — so reads resolve before
+        the intra-batch writes they overlap. Returns the (possibly
+        reordered) request list plus the plan whose ``restore`` maps
+        position-ordered results back to request order; (requests,
+        None) when the knob is off or the pass declined."""
+        if (not getattr(self.knobs, "commit_batch_scheduling", False)
+                or len(requests) < 2):
+            return requests, None
+        from foundationdb_tpu.server import scheduler
+
+        plan = scheduler.schedule(requests)
+        if plan is None or plan.identity:
+            return requests, None
+        self.sched_batches += 1
+        self.sched_reordered_total += plan.reordered
+        self.sched_deferred_total += plan.deferred
+        self._m_sched_reordered.inc(plan.reordered)
+        self._m_sched_deferred.inc(plan.deferred)
+        return [requests[i] for i in plan.order], plan
 
     def _build_txns(self, requests):
         rv_assigned = None
@@ -954,7 +1005,7 @@ class CommitProxy:
         return out
 
     def _finalize_batch(self, requests, txns, statuses, cv, window,
-                        prev=None, traced=True):
+                        prev=None, traced=True, plan=None):
         """Everything after resolution: result assembly, DD accounting,
         tlog push (1021 on quorum loss), storage apply, change feeds,
         version reporting, admission + durability pumping. ``prev``
@@ -1005,6 +1056,12 @@ class CommitProxy:
                         e.conflicting_key_ranges = self._conflicting_ranges(
                             txns[i]
                         )
+                        # the version whose writes rejected this txn:
+                        # the client repair engine re-reads ONLY the
+                        # conflicting keys at exactly this version —
+                        # its non-conflicting reads are resolver-proven
+                        # unchanged through it (txn/repair.py)
+                        e.conflict_version = cv
                     results.append(e)
                     batch_conflicts += 1
 
@@ -1054,7 +1111,12 @@ class CommitProxy:
                 )
             finally:
                 span_mod.set_current(prior_ctx)
-                bsp.finish(version=cv, conflicts=batch_conflicts)
+                if plan is not None:
+                    bsp.finish(version=cv, conflicts=batch_conflicts,
+                               sched_reordered=plan.reordered,
+                               sched_deferred=plan.deferred)
+                else:
+                    bsp.finish(version=cv, conflicts=batch_conflicts)
         finally:
             if prev is not None and self.log_gate is not None:
                 self.log_gate.advance(cv)

@@ -37,6 +37,124 @@ def run_txn(db, fn):
             tr.reset()
 
 
+def run_txn_repair(db, fn, stats=None):
+    """Repair-aware cooperative runner (txn/repair.py): on a retryable
+    conflict it first tries repair — a replayed transaction resubmits
+    WITHOUT re-running ``fn`` (re-running would double-apply the
+    restored mutations); a cache-seeded one re-runs ``fn`` against the
+    verified snapshot. Unrepaired errors reset cold, like ``run_txn``
+    (no backoff sleep: the sim scheduler owns time). ``stats`` (when
+    given) tallies attempts/conflicts/repairs for the test's asserts.
+    """
+    tr = db.create_transaction()
+    result = None
+    while True:
+        yield
+        try:
+            if not tr.repair_ready:
+                result = fn(tr)
+            fut = tr.commit_async()
+            while not fut.done():
+                yield  # the scheduler's pump() forms the batch
+            tr.commit_finish(fut)
+            return ("committed", result, tr)
+        except FDBError as e:
+            if e.code == 1021:
+                return ("unknown", None, tr)
+            if not e.is_retryable:
+                raise
+            if stats is not None:
+                stats["conflicts"] = stats.get("conflicts", 0) + 1
+            if tr.try_repair(e):
+                if stats is not None:
+                    stats["repairs"] = stats.get("repairs", 0) + 1
+            else:
+                tr.reset()
+
+
+def tpcc_workload(db, n_districts, n_ops, rng, stats, prefix=b"tpcc/",
+                  repair=True):
+    """New-order-shaped contention (the bench's tpcc client as a sim
+    actor): RMW on a hot district counter + an order-row insert keyed
+    by the read value + a blind stock update. The value-dependent hot
+    read is exactly the shape the repair engine's digest check must
+    catch — a stale district counter replayed verbatim would assign a
+    duplicate order id. ``repair=False`` runs the same ops through the
+    restart-only path for the differential test."""
+    dkey = lambda d: prefix + b"district/%03d" % d
+    for t in range(n_ops):
+        d = rng.randrange(n_districts)
+        s = rng.randrange(n_districts * 4)
+
+        def fn(tr, d=d, s=s):
+            cur = tr.get(dkey(d))
+            oid = int(cur or b"0") + 1
+            tr.set(dkey(d), b"%d" % oid)
+            tr.set(dkey(d) + b"/order/%08d" % oid, b"o" * 16)
+            tr.set(prefix + b"stock/%06d" % s, b"s" * 8)
+            return oid
+
+        if repair:
+            outcome, _, _tr = yield from run_txn_repair(db, fn, stats)
+        else:
+            outcome, _, _tr = yield from _run_txn_async(db, fn, stats)
+        if outcome == "committed":
+            stats["committed"] = stats.get("committed", 0) + 1
+            stats.setdefault("per_district", {})
+            stats["per_district"][d] = stats["per_district"].get(d, 0) + 1
+        else:
+            stats["unknown"] = stats.get("unknown", 0) + 1
+
+
+def _run_txn_async(db, fn, stats=None):
+    """The restart-only twin of ``run_txn_repair``: identical async
+    commit protocol, cold reset on every retryable error — the
+    differential baseline."""
+    tr = db.create_transaction()
+    while True:
+        yield
+        try:
+            result = fn(tr)
+            fut = tr.commit_async()
+            while not fut.done():
+                yield
+            tr.commit_finish(fut)
+            return ("committed", result, tr)
+        except FDBError as e:
+            if e.code == 1021:
+                return ("unknown", None, tr)
+            if not e.is_retryable:
+                raise
+            if stats is not None:
+                stats["conflicts"] = stats.get("conflicts", 0) + 1
+            tr.reset()
+
+
+def tpcc_check(db, n_districts, stats, prefix=b"tpcc/"):
+    """Serializability-equivalence invariant: every district counter
+    equals its committed new-order count, and the order rows under it
+    are exactly 1..counter (a lost update, double-applied repair, or
+    replayed-stale-read would all break the sequence)."""
+    per = stats.get("per_district", {})
+    assert stats.get("unknown", 0) == 0, "ambiguous outcomes in a " \
+        "fault-free differential run"
+    for d in range(n_districts):
+        key = prefix + b"district/%03d" % d
+        row = db.get(key)
+        count = int(row) if row is not None else 0
+        assert count == per.get(d, 0), (
+            f"district {d}: counter {count} != committed {per.get(d, 0)}"
+        )
+        orders = db.get_range_startswith(key + b"/order/")
+        assert len(orders) == count, (
+            f"district {d}: {len(orders)} order rows != counter {count}"
+        )
+        for i, (k, _) in enumerate(orders):
+            assert k == key + b"/order/%08d" % (i + 1), (
+                f"district {d}: order id gap at {k!r}"
+            )
+
+
 def _enc(i):
     return struct.pack(">I", i)
 

@@ -258,6 +258,21 @@ class Cli:
                 f"  GRV p99             - {roll['grv_latency_p99_ms']}",
                 f"  Hottest stage       - {roll.get('hottest_stage')}",
             )
+        # conflict repair + abort-aware batch scheduling (shown once
+        # either subsystem has done anything, so a default-off cluster's
+        # status stays unchanged)
+        if roll.get("repair_attempts") or roll.get("sched_reordered") \
+                or roll.get("sched_deferred"):
+            self._p(
+                "Conflict management:",
+                f"  Repairs             - "
+                f"{roll.get('repair_commits', 0)} committed / "
+                f"{roll.get('repair_attempts', 0)} attempted "
+                f"({roll.get('repair_fallbacks', 0)} fell back)",
+                f"  Scheduler           - "
+                f"{roll.get('sched_reordered', 0)} reordered, "
+                f"{roll.get('sched_deferred', 0)} deferred",
+            )
         self._p(
             f"Generation: {c['generation']}",
             f"Latest version: {c['latest_version']}",

@@ -10,10 +10,21 @@ from foundationdb_tpu.txn.transaction import Transaction
 
 
 def retry_loop(tr, fn):
-    """The transactional retry protocol, shared by Database and Tenant."""
+    """The transactional retry protocol, shared by Database and Tenant.
+
+    Repair-aware (txn/repair.py): after a conflict the engine repaired
+    by replaying the op log verbatim, ``tr.repair_ready`` is set and the
+    body must NOT re-run — the restored mutations resubmit as-is (the
+    previous attempt's result is the result). Every other retry re-runs
+    ``fn`` as usual (a repaired-but-value-dependent retry rides the
+    seeded read cache inside the transaction transparently)."""
+    result = None
     while True:
         try:
-            result = fn(tr)
+            # getattr: wrapper transactions (TenantTransaction) expose
+            # the retry surface but not necessarily the repair flag
+            if not getattr(tr, "repair_ready", False):
+                result = fn(tr)
             tr.commit()
             return result
         except FDBError as e:

@@ -107,6 +107,17 @@ class TransactionOptions:
         becomes exactly-once without the caller inventing tokens."""
         self._tr._auto_idempotency = True
 
+    def set_transaction_repair(self):
+        """Enable conflict repair for this transaction regardless of the
+        ``txn_repair`` knob (txn/repair.py): on ``not_committed`` with
+        conflicting-key info, re-read only the conflicting keys at the
+        rejecting commit version and replay (or cache-seed) the retry
+        instead of restarting cold."""
+        if self._tr._repair is None:
+            from foundationdb_tpu.txn.repair import RepairEngine
+
+            self._tr._repair = RepairEngine()
+
     def set_trace(self):
         """Force this transaction's trace to be SAMPLED regardless of
         ``tracing_sample_rate`` (ref: the DEBUG_TRANSACTION_IDENTIFIER
@@ -188,6 +199,19 @@ class Transaction:
         self._special_writes = []  # buffered \xff\xff management writes
         self._conflicting_ranges = None  # from a failed reporting commit
         self._watches_pending = []  # [(key, seen_value, Watch-placeholder)]
+        # conflict repair (txn/repair.py): the op-log recorder (None =
+        # repair off — every check below is one attribute test), the
+        # verified read caches a repaired retry serves from, and the
+        # replay/commit bookkeeping flags
+        self._repair = None
+        if getattr(knobs, "txn_repair", False):
+            from foundationdb_tpu.txn.repair import RepairEngine
+
+            self._repair = RepairEngine()
+        self._repair_cache = None  # key -> value, proven at _read_version
+        self._repair_range_cache = None  # (b,e,limit,rev) -> tuple(rows)
+        self._repair_ready = False  # op log replayed: commit, skip the body
+        self._repair_assisted = False  # this attempt rode a repair
         # distributed tracing (utils/span.py): the lazy root span (None
         # until the first traced op; NULL when unsampled or off), the
         # in-flight commit span, and the per-txn force-sample flag. The
@@ -285,38 +309,67 @@ class Transaction:
         if self._state == "cancelled":
             raise err("transaction_cancelled")
 
-    def _traced_read(self, key, rv):
+    def _traced_read(self, key, rv, snapshot=False):
         """One storage point read, wrapped in a ``txn.read`` span when
         this transaction is traced (the span's context rides the read
-        RPC as the wire's tracing frame)."""
-        sp = self._span
-        if sp is None or not sp.sampled:
-            return self._cluster.read_storage(key).get(key, rv)
-        rsp = sp.child("txn.read")
-        prior = span_mod.set_current(rsp.context())
-        try:
-            return self._cluster.read_storage(key).get(key, rv)
-        finally:
-            span_mod.set_current(prior)
-            rsp.finish()
+        RPC as the wire's tracing frame). A repaired retry serves the
+        read from the verified cache (txn/repair.py) — the cached value
+        is resolver-proven equal to storage at ``rv`` — and the repair
+        engine records every storage-backed non-snapshot read."""
+        cache = self._repair_cache
+        if cache is not None and key in cache:
+            val = cache[key]
+        else:
+            sp = self._span
+            if sp is None or not sp.sampled:
+                val = self._cluster.read_storage(key).get(key, rv)
+            else:
+                rsp = sp.child("txn.read")
+                prior = span_mod.set_current(rsp.context())
+                try:
+                    val = self._cluster.read_storage(key).get(key, rv)
+                finally:
+                    span_mod.set_current(prior)
+                    rsp.finish()
+        eng = self._repair
+        if eng is not None and not snapshot and key not in eng.point_reads:
+            eng.point_reads[key] = val
+        return val
 
-    def _traced_range(self, st, b, e, rv, limit, reverse):
-        """One storage range read under a ``txn.read_range`` span."""
-        sp = self._span
-        if sp is None or not sp.sampled:
-            return st.get_range(b, e, rv, limit=limit, reverse=reverse)
-        rsp = sp.child("txn.read_range")
-        prior = span_mod.set_current(rsp.context())
-        try:
-            return st.get_range(b, e, rv, limit=limit, reverse=reverse)
-        finally:
-            span_mod.set_current(prior)
-            rsp.finish()
+    def _traced_range(self, st, b, e, rv, limit, reverse, snapshot=False):
+        """One storage range read under a ``txn.read_range`` span, with
+        the same repair-cache service and op-log recording as
+        ``_traced_read`` (keyed by the full call signature)."""
+        sig = (b, e, limit, reverse)
+        rcache = self._repair_range_cache
+        if rcache is not None and sig in rcache:
+            out = list(rcache[sig])
+        else:
+            sp = self._span
+            if sp is None or not sp.sampled:
+                out = st.get_range(b, e, rv, limit=limit, reverse=reverse)
+            else:
+                rsp = sp.child("txn.read_range")
+                prior = span_mod.set_current(rsp.context())
+                try:
+                    out = st.get_range(b, e, rv, limit=limit,
+                                       reverse=reverse)
+                finally:
+                    span_mod.set_current(prior)
+                    rsp.finish()
+        eng = self._repair
+        if eng is not None and not snapshot and sig not in eng.range_reads:
+            eng.range_reads[sig] = tuple(out)
+        return out
 
     def get(self, key, snapshot=False):
         self._guard()
         key = _check_key(key)
         if key.startswith(b"\xff") and specialkeys.contains(key):
+            if self._repair is not None:
+                # virtual-module rows aren't verifiable at a later
+                # version: this op log never auto-replays
+                self._repair.unreplayable = True
             return specialkeys.get(self, key)
         rv = self.get_read_version()
         if not self._ryw_disabled:
@@ -324,11 +377,11 @@ class Transaction:
             if known:
                 if not needs_base:
                     return self._writes.fold(entry, None)
-                base = self._traced_read(key, rv)
+                base = self._traced_read(key, rv, snapshot)
                 if not snapshot:
                     self._add_read_conflict(key, key_successor(key))
                 return self._writes.fold(entry, base)
-        val = self._traced_read(key, rv)
+        val = self._traced_read(key, rv, snapshot)
         if not snapshot:
             self._add_read_conflict(key, key_successor(key))
         return val
@@ -340,6 +393,11 @@ class Transaction:
             # space (module rows are materialized, not stored)
             raise err("key_outside_legal_range")
         rv = self.get_read_version()
+        if self._repair is not None:
+            # selector resolution isn't recorded key-by-key, so it
+            # can't be re-verified at the repair version: fall back to
+            # the seeded rerun, never the verbatim replay
+            self._repair.unreplayable = True
         k = self._cluster.read_storage().resolve_selector(selector, rv)
         if not snapshot and k not in (b"", b"\xff"):
             self._add_read_conflict(k, key_successor(k))
@@ -359,6 +417,8 @@ class Transaction:
             # rejects selectors against most special-key modules too)
             if not specialkeys.contains(begin) or not isinstance(end, bytes):
                 raise err("key_outside_legal_range")
+            if self._repair is not None:
+                self._repair.unreplayable = True
             return specialkeys.get_range(
                 self, begin, min(end, specialkeys.END),
                 limit=limit, reverse=reverse,
@@ -381,9 +441,9 @@ class Transaction:
         if not overlaps:
             # fast path: no uncommitted writes in range — push limit/reverse
             # down to storage instead of materializing the whole range
-            out = self._traced_range(st, b, e, rv, limit, reverse)
+            out = self._traced_range(st, b, e, rv, limit, reverse, snapshot)
         else:
-            rows = dict(self._traced_range(st, b, e, rv, 0, False))
+            rows = dict(self._traced_range(st, b, e, rv, 0, False, snapshot))
             for cb, ce in self._writes.cleared_in(b, e):
                 for k in [k for k in rows if cb <= k < ce]:
                     del rows[k]
@@ -561,6 +621,8 @@ class Transaction:
         """Ref: fdb_transaction_get_estimated_range_size_bytes (sampled
         storage metrics — an estimate, not an exact byte count)."""
         self._guard()
+        if self._repair is not None:
+            self._repair.unreplayable = True  # sampled, not re-verifiable
         return self._cluster.estimated_range_size_bytes(
             _check_key(begin), _check_key(end)
         )
@@ -570,6 +632,8 @@ class Transaction:
         cutting [begin, end) into ~chunk_size-byte chunks (includes both
         endpoints)."""
         self._guard()
+        if self._repair is not None:
+            self._repair.unreplayable = True
         return self._cluster.range_split_points(
             _check_key(begin), _check_key(end), int(chunk_size)
         )
@@ -646,7 +710,10 @@ class Transaction:
             mutations=list(self._mutation_log),
             read_conflict_ranges=rcr,
             write_conflict_ranges=wcr,
-            report_conflicting_keys=self._report_conflicting_keys,
+            # the repair engine needs the conflicting ranges AND the
+            # rejecting commit version on every 1020 it might repair
+            report_conflicting_keys=(self._report_conflicting_keys
+                                     or self._repair is not None),
             lock_aware=self._lock_aware,
             idempotency_id=idmp,
             flat_conflicts=flat,
@@ -698,6 +765,13 @@ class Transaction:
         # the data half is durable regardless of what the management
         # half does below: record it first so the client can always
         # observe what committed (mixed transactions are not atomic)
+        if self._repair_assisted:
+            # a repaired retry made it durable: the goodput the engine
+            # exists for (txn/repair.py; rides the proxy registry)
+            from foundationdb_tpu.txn import repair as repair_mod
+
+            repair_mod.note(self._cluster, "repair_commits")
+            self._repair_assisted = False
         self._committed_version = result
         self._versionstamp = Versionstamp.from_version(result).tr_version
         self._trace_commit_done(None)
@@ -783,8 +857,31 @@ class Transaction:
                 and self._cluster.lock_uid() is not None:
             raise err("database_locked")
 
+    @property
+    def repair_ready(self):
+        """True when a conflict repair replayed this transaction's op
+        log verbatim (txn/repair.py): the retry loop should resubmit —
+        ``commit()`` / ``commit_async()`` — WITHOUT re-running the
+        body; running it anyway would double-apply the restored
+        mutations."""
+        return self._repair_ready
+
+    def try_repair(self, error):
+        """Attempt conflict repair for a failed commit instead of the
+        cold restart (txn/repair.py). True = repaired: the read version
+        moved to the rejecting commit version, reads are verified or
+        refreshed, no backoff is owed — retry immediately (checking
+        :attr:`repair_ready` first). False = restart cold (the caller
+        owns reset/backoff). ``on_error`` calls this automatically."""
+        if not isinstance(error, FDBError):
+            return False
+        from foundationdb_tpu.txn import repair as repair_mod
+
+        return repair_mod.attempt(self, error)
+
     def commit(self):
         self._guard()
+        self._repair_ready = False  # consumed: this IS the resubmission
         if not self._mutation_log and not self._write_conflicts:
             # read-only (or management-only): nothing to resolve
             # (ref: read-only commits skip proxies)
@@ -808,6 +905,7 @@ class Transaction:
         (BatchingCommitProxy); the plain synchronous proxy does not.
         """
         self._guard()
+        self._repair_ready = False  # consumed: this IS the resubmission
         if not self._mutation_log and not self._write_conflicts:
             from foundationdb_tpu.server.batcher import CommitFuture
 
@@ -848,6 +946,12 @@ class Transaction:
         self._retries += 1
         if self._retry_limit is not None and self._retries > self._retry_limit:
             raise error
+        if self.try_repair(error):
+            # repaired (txn/repair.py): read version moved to the
+            # rejecting commit version, reads verified or refreshed —
+            # no backoff owed, retry immediately (repair_ready decides
+            # whether the body re-runs)
+            return
         delay = min(self._backoff, self._max_retry_delay)
         time.sleep(delay)
         self._backoff = self._backoff * self.db._knobs.backoff_growth

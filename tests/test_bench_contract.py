@@ -122,9 +122,21 @@ def test_e2e_line_folds_proxies_and_platform():
                 "pipeline_depth", "pipeline_depth_effective",
                 "pack_path", "pack_bytes", "pack_reuse_rate",
                 "commit_p50_ms", "commit_p99_ms", "grv_p99_ms",
-                "spans_sampled", "tracing_sample_rate"):
+                "spans_sampled", "tracing_sample_rate",
+                # conflict management (ISSUE 6): every line states
+                # whether repair/scheduling ran and what they did
+                "e2e_repair_enabled", "e2e_sched_enabled",
+                "e2e_retry_mode", "repair_attempts", "repair_commits",
+                "repair_fallbacks", "repair_rate",
+                "sched_batches", "sched_reordered", "sched_deferred"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
+    # repair/scheduling default OFF: the gauges must say so explicitly
+    assert fields["e2e_repair_enabled"] is False
+    assert fields["e2e_sched_enabled"] is False
+    assert fields["e2e_retry_mode"] == "discard"
+    assert fields["repair_attempts"] == 0
+    assert fields["sched_batches"] == 0
     # tracing defaults OFF: the gauge must say so explicitly
     assert fields["spans_sampled"] == 0
     assert fields["tracing_sample_rate"] == 0.0
@@ -189,6 +201,29 @@ def test_tracing_smoke_spans_actually_flow():
                                           "apply")
     assert out["hottest_stage_timers"] in ("pack", "dispatch", "resolve",
                                            "apply")
+
+
+def test_repair_smoke_contract():
+    """BENCH_MODE=repair_smoke: the conflict-management probe emits the
+    paired completion-goodput comparison (repair+scheduling vs the
+    cold-restart protocol) plus the discard reference, and the enabled
+    arm's repair machinery actually engaged on the contended tpcc
+    shape. One short round checks the contract; the bench run owns the
+    statistically serious comparison."""
+    out = bench.run_repair_smoke(cpu=True, seconds=0.6, rounds=1)
+    for key in ("value", "vs_baseline", "restart_only_txns_per_sec",
+                "discard_txns_per_sec", "speedup_repair",
+                "conflict_rate_on", "conflict_rate_off", "repair_rate",
+                "repair_attempts", "repair_commits", "repair_fallbacks",
+                "sched_batches", "sched_reordered", "sched_deferred",
+                "commit_p50_ms", "commit_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_repair_smoke"
+    assert out["value"] > 0
+    # tpcc at this contention conflicts constantly: the enabled arm
+    # must have attempted repairs (and the counters flowed end to end)
+    assert out["repair_attempts"] > 0
+    assert out["repair_fallbacks"] > 0
 
 
 def test_pack_smoke_contract():

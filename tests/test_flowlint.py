@@ -119,6 +119,34 @@ def test_fl001_span_ids_on_the_seam_pass():
     assert findings == []
 
 
+def test_fl001_flags_raw_shuffle_in_the_batch_scheduler():
+    """Scheduler (and repair) randomness must ride the deterministic
+    seam (ISSUE 6 satellite): a raw random.shuffle tie-break in the
+    commit scheduler would make same-seed sims resolve batches in
+    divergent orders — FL001 must trip on it."""
+    findings = lint("server/scheduler.py", """
+        import random
+
+        def schedule(requests):
+            order = list(range(len(requests)))
+            random.shuffle(order)
+            return order
+    """)
+    assert rules_of(findings) == ["FL001"]
+
+
+def test_fl001_seamed_scheduler_tiebreak_passes():
+    findings = lint("server/scheduler.py", """
+        from foundationdb_tpu.core import deterministic
+
+        def schedule(requests):
+            order = list(range(len(requests)))
+            deterministic.rng("sched-tiebreak").shuffle(order)
+            return order
+    """)
+    assert findings == []
+
+
 # ───────────────────────────── FL002 ─────────────────────────────
 def test_fl002_flags_risky_call_before_settlement():
     findings = lint("server/foo.py", """
