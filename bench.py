@@ -653,6 +653,10 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
                             pending.append((tr, tr.commit_async(), tj, k))
                 for _ in range(window - len(pending)):
                     tr = db.create_transaction()
+                    # workload attribution: every bench txn carries its
+                    # workload shape as a transaction tag, so the
+                    # per-tag rollups on the line below are live
+                    tr.options.set_tag(e2e_mode)
                     build_txn(tr, rng_state, j)
                     pending.append((tr, tr.commit_async(), j, 0))
                     j += 1
@@ -714,6 +718,20 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     # commit/GRV latency bands from the new metrics subsystem (merged
     # across the proxy fleet): the <2ms-added-p99 target, measured
     roll = cluster.metrics_status()["rollups"]
+    # workload attribution (utils/heatmap.py): the heatmaps are
+    # cluster-owned, so like the registries they outlive close()
+    hot = cluster.hot_ranges_status()
+
+    def _hottest(dim):
+        rows = hot["hot_ranges"].get(dim) or ()
+        return max(rows, key=lambda r: r["heat"])["begin"] if rows \
+            else None
+
+    tags = hot["tags"]
+    busiest = max(
+        tags, key=lambda t: (tags[t].get("busyness", 0.0),
+                             tags[t].get("started", 0))
+    ) if tags else None
     return {
         "commit_p50_ms": roll["commit_latency_p50_ms"],
         "commit_p99_ms": roll["commit_latency_p99_ms"],
@@ -753,6 +771,21 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "repair_fallbacks": roll.get("repair_fallbacks", 0),
         "repair_rate": round(
             roll.get("repair_commits", 0) / max(total, 1), 4),
+        # workload attribution: hot-range + per-tag visibility on every
+        # e2e line — bucket count across the three dimensions, the
+        # hottest range per dimension, total conflict heat (≈ decayed
+        # abort mass), and the tag rollup's shape
+        "hot_range_buckets": sum(
+            len(v) for v in hot["hot_ranges"].values()),
+        "hot_range_top_conflict": _hottest("conflict"),
+        "hot_range_top_read": _hottest("read"),
+        "hot_range_top_write": _hottest("write"),
+        "hot_range_conflict_heat": hot["totals"]["conflict"]["heat"],
+        "tags_seen": len(tags),
+        "tag_busiest": busiest,
+        "tag_busiest_busyness": (
+            tags[busiest].get("busyness") if busiest else None),
+        "workload_sampling": hot["sampling"],
         # distributed tracing: how many transactions carried a sampled
         # trace this run (0 when the knob is off — the field rides
         # every line so its absence is never ambiguous)
@@ -1564,6 +1597,66 @@ def run_metrics_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_heatmap_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=heatmap_smoke: the workload-attribution subsystem's
+    overhead budget, measured — the ycsb e2e with the heatmap kill
+    switch ON (conflict charging + storage key sampling + per-tag
+    counters live) vs OFF, interleaved pairs, median throughput each,
+    ≤2% budget (the metrics_smoke protocol). The enabled arm's
+    hot-range/tag fields ride along so the smoke also proves the
+    heatmaps actually populated under the measured load."""
+    from foundationdb_tpu.utils import heatmap as heatmap_mod
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    backend = "native"
+    runs = {True: [], False: []}
+    fields_on = None
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                heatmap_mod.set_enabled(on)
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    fields_on = r
+    finally:
+        heatmap_mod.set_enabled(True)
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_heatmap_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "heatmap_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": fields_on.get("platform"),
+        "hot_range_buckets": fields_on.get("hot_range_buckets"),
+        "hot_range_top_conflict": fields_on.get("hot_range_top_conflict"),
+        "hot_range_top_read": fields_on.get("hot_range_top_read"),
+        "hot_range_conflict_heat": fields_on.get(
+            "hot_range_conflict_heat"),
+        "tags_seen": fields_on.get("tags_seen"),
+        "tag_busiest": fields_on.get("tag_busiest"),
+        "commit_p50_ms": fields_on.get("commit_p50_ms"),
+        "commit_p99_ms": fields_on.get("commit_p99_ms"),
+    }
+
+
 def run_tracing_smoke(cpu, seconds=None, rounds=None, rate=None):
     """BENCH_MODE=tracing_smoke: the distributed-tracing overhead
     budget, measured — the ycsb e2e with tracing at the DEFAULT enabled
@@ -1768,6 +1861,7 @@ def _compact_summary(out, configs):
               "stage_apply_ms",
               "pipeline_depth_effective", "pack_path", "pack_bytes",
               "pack_reuse_rate", "spans_sampled", "repair_rate",
+              "hot_range_buckets", "hot_range_top_conflict", "tags_seen",
               "flowlint_findings",
               "tpu_recovered", "fallback_from", "error"):
         if out.get(k) is not None:
@@ -1804,6 +1898,8 @@ def main():
     # span-tree vs stage-timer critical-path cross-check) |
     # repair_smoke (conflict repair + abort-aware scheduling vs the
     # restart-only baseline on the contended tpcc shape) |
+    # heatmap_smoke (workload-attribution overhead: heatmap kill switch
+    # on vs off, ≤2% budget) |
     # sharded_e2e (internal: the multilane re-exec child)
     # only the default multi-config run plans recovery re-execs, so only
     # it earns the wider deadline (worst case 60+500+120+650s of
@@ -1881,6 +1977,15 @@ def main():
         _emit(out)
         # the ≤2% budget is a gate, not a log line: a blown budget
         # exits nonzero so CI trajectories catch the regression
+        if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "heatmap_smoke":
+        out = run_heatmap_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # same contract as metrics_smoke: the ≤2% budget is a GATE
         if not out["within_budget"]:
             sys.exit(1)
         return

@@ -20,12 +20,12 @@ class CommitRequest:
     __slots__ = ("read_version", "mutations", "_read_conflict_ranges",
                  "_write_conflict_ranges", "report_conflicting_keys",
                  "lock_aware", "idempotency_id", "flat_conflicts",
-                 "span_context")
+                 "span_context", "tags")
 
     def __init__(self, read_version, mutations, read_conflict_ranges,
                  write_conflict_ranges, report_conflicting_keys=False,
                  lock_aware=False, idempotency_id=None,
-                 flat_conflicts=None, span_context=None):
+                 flat_conflicts=None, span_context=None, tags=()):
         self.read_version = read_version
         self.mutations = mutations
         self._read_conflict_ranges = read_conflict_ranges  # [(begin, end)]
@@ -46,6 +46,10 @@ class CommitRequest:
         # transactions share one wire frame / batcher queue. None for
         # untraced (or unsampled) transactions.
         self.span_context = span_context
+        # workload attribution (ref: TransactionTagRef on
+        # CommitTransactionRequest): the client's set_tag() labels, so
+        # the proxy can attribute this commit/abort/conflict per tag
+        self.tags = tuple(tags) if tags else ()
 
     @property
     def read_conflict_ranges(self):

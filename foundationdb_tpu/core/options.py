@@ -96,6 +96,25 @@ class Knobs:
     # client-side buffered spans anyway
     tracing_slow_commit_ms: float = 200.0
 
+    # --- workload attribution (utils/heatmap.py) ---
+    # default-ON key sampling: conflict heat charged at the proxy's
+    # abort-fabrication site, read/write heat sampled storage-side.
+    # BENCH_MODE=heatmap_smoke measures the enabled-vs-kill-switch cost
+    # and gates it at <=2% like metrics_smoke.
+    workload_sampling: bool = True
+    # bounded histogram state: adjacent-range coalescing keeps each
+    # heatmap at most this many buckets no matter how long the run
+    heatmap_max_buckets: int = 64
+    # exponential decay half-life (injected-clock seconds): old heat
+    # fades so the snapshot reflects the CURRENT hot set
+    heatmap_half_life_s: float = 30.0
+    # storage-side read/write key sampling rate: one sampled key per
+    # this many accesses on average (ref: StorageMetrics byte-sampling;
+    # draws ride the "key-sample" deterministic stream). Charge weight
+    # scales by the stride, so heat stays an unbiased estimate of total
+    # accesses; 16 keeps the sampler inside the 2% overhead budget.
+    storage_sample_every: int = 16
+
     # --- simulation ---
     buggify: bool = False
     buggify_prob: float = 0.05

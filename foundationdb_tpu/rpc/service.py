@@ -107,6 +107,9 @@ class ClusterService:
             # the metrics section alone (monitoring agents poll this
             # without paying for the whole status document)
             "metrics": self.metrics,
+            # workload attribution: hot ranges + per-tag rollup alone
+            # (fdbcli `top`, tools/heatmap.py split-point advice)
+            "metrics_hot": self.metrics_hot,
             "get_read_version": self.get_read_version,
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
@@ -160,6 +163,9 @@ class ClusterService:
 
     def metrics(self):
         return self.cluster.metrics_status()
+
+    def metrics_hot(self, top=None):
+        return self.cluster.hot_ranges_status(top=top)
 
     def get_read_version(self, priority="default", tags=()):
         return self.cluster.grv_proxy.get_read_version(
@@ -650,6 +656,9 @@ class RemoteCluster:
 
     def metrics_status(self):
         return self._call("metrics")
+
+    def hot_ranges_status(self, top=None):
+        return self._call("metrics_hot", top)
 
     # management surface (the special key space's commit-time handles)
     def exclude_storage(self, sid):

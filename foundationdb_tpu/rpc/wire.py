@@ -26,7 +26,10 @@ from foundationdb_tpu.core.mutations import Mutation, Op
 # v6: conflict repair — a trailing conflict_version on the FDBError
 #     frame (the commit version whose writes rejected a reporting txn;
 #     the client repair engine re-reads its conflicting keys there)
-PROTOCOL_VERSION = 6
+# v7: workload attribution — a trailing optional tag list on both
+#     CommitRequest frames (set_tag labels; N = untagged), so the proxy
+#     can attribute commits/aborts/conflicts per tag
+PROTOCOL_VERSION = 7
 
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
@@ -106,6 +109,7 @@ def _enc(buf, v):
             buf.append(b"T" if v.lock_aware else b"F")
             _enc(buf, v.idempotency_id)
             _enc(buf, v.span_context)  # v5: tracing context (N = none)
+            _enc(buf, list(v.tags) if v.tags else None)  # v7: tags
             return
         buf.append(b"R")
         _enc(buf, v.read_version)
@@ -116,6 +120,7 @@ def _enc(buf, v):
         buf.append(b"T" if v.lock_aware else b"F")
         _enc(buf, v.idempotency_id)
         _enc(buf, v.span_context)  # v5: tracing context (N = none)
+        _enc(buf, list(v.tags) if v.tags else None)  # v7: tags
     elif t is FlatConflicts:
         buf.append(b"C")
         buf.append(struct.pack(
@@ -209,8 +214,10 @@ def _dec(r: _Reader):
         lock_aware = r.take(1) == b"T"
         idmp = _dec(r)
         sctx = _dec(r)
+        tags = _dec(r)
         return CommitRequest(rv, muts, rcr, wcr, report, lock_aware,
-                             idempotency_id=idmp, span_context=sctx)
+                             idempotency_id=idmp, span_context=sctx,
+                             tags=tuple(tags) if tags else ())
     if tag == b"Q":
         rv = _dec(r)
         muts = _dec(r)
@@ -219,11 +226,13 @@ def _dec(r: _Reader):
         lock_aware = r.take(1) == b"T"
         idmp = _dec(r)
         sctx = _dec(r)
+        tags = _dec(r)
         # range lists None: reconstructed lazily from the blobs only if
         # a legacy consumer asks (CommitRequest._from_flat)
         return CommitRequest(rv, muts, None, None, report, lock_aware,
                              idempotency_id=idmp, flat_conflicts=flat,
-                             span_context=sctx)
+                             span_context=sctx,
+                             tags=tuple(tags) if tags else ())
     if tag == b"C":
         num_limbs, rp, rr, wp, wr = struct.unpack(">BIIII", r.take(17))
         return FlatConflicts(

@@ -27,6 +27,7 @@ from foundationdb_tpu.server.router import StorageRouter
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog, TLogSystem
+from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
@@ -63,6 +64,12 @@ class Cluster:
         # counters survive recoveries the same way — they live in the
         # roles' stats collections aggregated by a long-lived process).
         self._metrics_store = {}
+        # Workload-attribution heatmaps, same ownership story: keyed
+        # (role, index) and handed to every incarnation of the role, so
+        # conflict/read/write heat survives txn-system recoveries,
+        # storage recruitment, and configure() shrink (absorbed, never
+        # rewound) exactly like the metric registries above.
+        self._heatmap_store = {}
         self.ratekeeper = Ratekeeper(
             target_tps=target_tps if target_tps is not None else 1e9,
             clock=rk_clock,
@@ -83,6 +90,13 @@ class Cluster:
             )
             for eng in storage_engines
         ]
+        if knobs.workload_sampling:
+            for i, s in enumerate(self.storages):
+                s.attach_heatmaps(
+                    self._role_heatmap("storage_read", i),
+                    self._role_heatmap("storage_write", i),
+                    knobs.storage_sample_every,
+                )
         # ── recovery (ref: Master recovery replaying tlogs into storage) ──
         # Replay WAL records newer than each storage's durable version,
         # then restart the version authority above everything recovered.
@@ -255,6 +269,25 @@ class Cluster:
         return [reg for (r, _), reg in sorted(self._metrics_store.items())
                 if r == role]
 
+    def _role_heatmap(self, role, i=0, decode=None):
+        """The persistent (role, index) heatmap — created on first use,
+        reused by every later incarnation of that role (the registry
+        accessor's exact twin)."""
+        key = (role, i)
+        hm = self._heatmap_store.get(key)
+        if hm is None:
+            hm = self._heatmap_store[key] = heatmap_mod.KeyRangeHeatmap(
+                f"{role}:{i}",
+                max_buckets=self.knobs.heatmap_max_buckets,
+                half_life_s=self.knobs.heatmap_half_life_s,
+                decode=decode,
+            )
+        return hm
+
+    def _role_heatmaps(self, role):
+        return [hm for (r, _), hm in sorted(self._heatmap_store.items())
+                if r == role]
+
     def _make_commit_proxy(self, resolve_gate=None, log_gate=None, index=0):
         return CommitProxy(
             self.sequencer, self.resolvers, self.tlog, self.storages,
@@ -262,6 +295,11 @@ class Cluster:
             change_feeds=self.change_feeds,
             resolve_gate=resolve_gate, log_gate=log_gate,
             metrics=self._role_registry("commit_proxy", index),
+            heatmap=(
+                self._role_heatmap("commit_proxy", index,
+                                   decode=heatmap_mod.entry_key)
+                if self.knobs.workload_sampling else None
+            ),
         )
 
     def _build_txn_frontend(self):
@@ -279,6 +317,13 @@ class Cluster:
                 self._role_registry(role, 0).absorb(
                     self._metrics_store.pop((role, i))
                 )
+        for (role, i) in list(self._heatmap_store):
+            if role == "commit_proxy" and i >= n:
+                # orphaned members' conflict heat folds into member 0:
+                # hot-range snapshots never rewind across a shrink
+                self._role_heatmap(
+                    role, 0, decode=heatmap_mod.entry_key
+                ).absorb(self._heatmap_store.pop((role, i)))
         if self.n_commit_proxies <= 1:
             return self._wire_pipeline(self._make_commit_proxy())
         from foundationdb_tpu.server.fleet import GrvFleet, ProxyFleet
@@ -495,6 +540,14 @@ class Cluster:
             engine=old.engine,
         )
         new.adopt_metrics(old.metrics)  # counters survive recruitment
+        if self.knobs.workload_sampling:
+            # same objects as the dead instance held (cluster-owned):
+            # per-shard read/write heat survives recruitment
+            new.attach_heatmaps(
+                self._role_heatmap("storage_read", sid),
+                self._role_heatmap("storage_write", sid),
+                self.knobs.storage_sample_every,
+            )
         smap = self.dd.map if self.replication < len(self.storages) else None
         from foundationdb_tpu.core.mutations import Op
 
@@ -934,6 +987,70 @@ class Cluster:
             "grv_latency_bands": grv,
         }
 
+    def _tag_rollup(self):
+        """Per-tag outcome totals folded across the role fleets (the
+        registries hold ``tag_{outcome}_{tag}`` counters), plus the
+        ratekeeper's last-window busyness gauge."""
+        out = {}
+        scans = (
+            ("commit_proxy", "tag_committed_", "committed"),
+            ("commit_proxy", "tag_conflicted_", "conflicted"),
+            ("commit_proxy", "tag_too_old_", "too_old"),
+            ("grv_proxy", "tag_started_", "started"),
+        )
+        snaps = {
+            role: [r.snapshot()["counters"] for r in
+                   self._role_registries(role)]
+            for role in ("commit_proxy", "grv_proxy")
+        }
+        for role, prefix, field in scans:
+            for counters in snaps[role]:
+                for name, v in counters.items():
+                    if name.startswith(prefix):
+                        row = out.setdefault(name[len(prefix):], {})
+                        row[field] = row.get(field, 0) + v
+        for tag, busy in self.ratekeeper.tag_busyness.items():
+            out.setdefault(tag, {})["busyness"] = busy
+        return {t: out[t] for t in sorted(out)}
+
+    def hot_ranges_status(self, top=None):
+        """The workload-attribution document (``metrics hot`` RPC /
+        \\xff\\xff/metrics/hot_ranges / cluster.workload): fleet-merged
+        conflict/read/write hot ranges — each a bounded decayed
+        key-range histogram — plus the per-tag rollup. ``top`` keeps
+        only the N hottest ranges per dimension."""
+        k = self.knobs
+        dims = {
+            "conflict": heatmap_mod.merged(
+                self._role_heatmaps("commit_proxy"), name="conflict",
+                max_buckets=k.heatmap_max_buckets,
+                half_life_s=k.heatmap_half_life_s,
+                decode=heatmap_mod.entry_key,
+            ),
+            "read": heatmap_mod.merged(
+                self._role_heatmaps("storage_read"), name="read",
+                max_buckets=k.heatmap_max_buckets,
+                half_life_s=k.heatmap_half_life_s,
+            ),
+            "write": heatmap_mod.merged(
+                self._role_heatmaps("storage_write"), name="write",
+                max_buckets=k.heatmap_max_buckets,
+                half_life_s=k.heatmap_half_life_s,
+            ),
+        }
+        return {
+            "sampling": bool(k.workload_sampling) and heatmap_mod.enabled(),
+            "hot_ranges": {
+                name: hm.snapshot(top=top) for name, hm in dims.items()
+            },
+            "totals": {
+                name: {"heat": round(hm.total_heat(), 4),
+                       "charges": hm.charges}
+                for name, hm in dims.items()
+            },
+            "tags": self._tag_rollup(),
+        }
+
     def _trace_status(self):
         """The trace/span pipeline's own health: per-type suppression
         (satellite of flow/Trace.cpp event suppression) and the tracing
@@ -968,6 +1085,7 @@ class Cluster:
             or tlog_info["live"] < tlog_info["count"]
             or any(not r.alive for r in self.resolvers)
         )
+        hot = self.hot_ranges_status()
         return {
             "cluster": {
                 "generation": self.generation,
@@ -1006,7 +1124,12 @@ class Cluster:
                                 "commit_proxy", "abort_transaction_too_old")},
                         "started": {"counter": self._sum_counter(
                             "grv_proxy", "grv_grants")},
-                    }
+                    },
+                    # workload attribution: WHICH keys/tags the traffic
+                    # above actually hit (utils/heatmap.py)
+                    "hot_ranges": hot["hot_ranges"],
+                    "hot_range_totals": hot["totals"],
+                    "tags": hot["tags"],
                 },
                 "metrics": self.metrics_status(),
                 # observability plumbing health: process-wide (cumulative

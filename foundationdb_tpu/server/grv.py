@@ -34,6 +34,18 @@ class GrvProxy:
         self._m_grants = self.metrics.counter("grv_grants")
         self._m_throttled = self.metrics.counter("grv_throttled")
         self._m_tag_throttled = self.metrics.counter("grv_tag_throttled")
+        self._m_tag_started = {}  # tag -> counter handle (lazy)
+
+    def _note_tag_started(self, tags):
+        """Per-tag started counters (workload attribution): the tag
+        rollup's denominator. Lives in the role registry so recovery
+        absorption carries it like every other counter."""
+        for t in tags:
+            c = self._m_tag_started.get(t)
+            if c is None:
+                c = self._m_tag_started[t] = self.metrics.counter(
+                    "tag_started_" + t)
+            c.inc()
 
     def get_read_version(self, priority="default", tags=()):
         if not getattr(self.sequencer, "alive", True):
@@ -54,6 +66,8 @@ class GrvProxy:
                 raise err("process_behind")
         self.grv_count += 1
         self._m_grants.inc()
+        if tags:
+            self._note_tag_started(tags)
         v = self.sequencer.committed_version
         # a traced request (in-process ambient context or the wire's
         # tracing frame) gets its grant recorded as a server-side hop
@@ -127,6 +141,11 @@ class BatchingGrvProxy:
             # per-tag queues in GrvProxyTagThrottler); the global
             # budget is charged by the grant loop as usual
             raise err("tag_throttled")
+        if tags:
+            # the batcher's fast path and grant loop are tag-blind (one
+            # committed-version read for the whole round): attribute the
+            # start HERE, where the tags are still in hand
+            self.inner._note_tag_started(tags)
         qkey = "batch" if priority == "batch" else "default"
         fast_v = None
         with self._lock:

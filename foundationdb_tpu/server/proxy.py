@@ -20,6 +20,7 @@ from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
+from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -98,7 +99,8 @@ class _PipelinedGroup:
 class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None,
-                 resolve_gate=None, log_gate=None, metrics=None):
+                 resolve_gate=None, log_gate=None, metrics=None,
+                 heatmap=None):
         self.alive = True
         # per-role metrics (ref: Stats.h CounterCollection on the commit
         # proxy). The cluster hands recovery incarnations the SAME
@@ -109,6 +111,13 @@ class CommitProxy:
         self._m_committed = self.metrics.counter("txn_committed")
         self._m_batches = self.metrics.counter("commit_batches")
         self._abort_counters = {}
+        # workload attribution (utils/heatmap.py): the cluster-owned
+        # conflict heatmap this incarnation charges at its abort-
+        # fabrication site (None = sampling off), plus lazy per-tag
+        # outcome counters in the role registry (tag_committed_x, ...)
+        # so recovery absorption carries them like any other counter
+        self.conflict_heat = heatmap
+        self._tag_counters = {}
         # commit_e2e spans: recorded HERE for bare (sync) deployments;
         # a batching wrapper claims ownership at construction and
         # records the wider submit→settle span instead (queue included)
@@ -169,6 +178,61 @@ class CommitProxy:
                 f"abort_{name}"
             )
         c.inc(n)
+
+    def _note_tags(self, outcome, tags):
+        """Per-tag outcome accounting (ref: the per-tag counters
+        TagThrottle reads): every tagged commit/abort/conflict lands in
+        a ``tag_{outcome}_{tag}`` counter."""
+        if not tags or not metrics_mod.enabled():
+            return
+        for t in tags:
+            key = (outcome, t)
+            c = self._tag_counters.get(key)
+            if c is None:
+                c = self._tag_counters[key] = self.metrics.counter(
+                    f"tag_{outcome}_{t}"
+                )
+            c.inc()
+
+    def _charge_conflict(self, req):
+        """Charge the conflict heatmap for one rejected transaction at
+        its fabrication site. On the flat path the charged bucket keys
+        are the client's raw limb ENTRIES sliced straight out of the
+        request blobs — order-isomorphic to keys, zero decode (the same
+        trick as server/scheduler.py); legacy requests pay one cheap
+        entry encode per key, abort path only. The abort's unit weight
+        is split across its charged read entries so total heat counts
+        ABORTS (the attribution tests' denominator), not read width."""
+        hm = self.conflict_heat
+        if hm is None or not heatmap_mod.enabled():
+            return
+        from foundationdb_tpu.core import flatpack
+
+        entries = []
+        f = req.flat_conflicts
+        if f is not None:
+            w = flatpack.entry_width(f.num_limbs)
+            blob = f.read_point_blob
+            for o in range(0, min(len(blob), 8 * w), w):
+                entries.append(blob[o: o + w])
+            rblob = f.read_range_blob  # pairs: charge each range BEGIN
+            for o in range(0, min(len(rblob), 16 * w), 2 * w):
+                entries.append(rblob[o: o + w])
+            if not entries:  # read-free: charge the write set instead
+                blob = f.write_point_blob
+                for o in range(0, min(len(blob), 8 * w), w):
+                    entries.append(blob[o: o + w])
+        else:
+            limbs = self.knobs.key_limbs
+            ranges = req.read_conflict_ranges or req.write_conflict_ranges
+            for begin, _end in ranges[:8]:
+                e = flatpack.encode_entry(begin, limbs)
+                if e is not None:  # over-capacity keys stay unsampled
+                    entries.append(e)
+        if entries:
+            wgt = 1.0 / len(entries)
+            for e in entries:
+                hm.charge(e, wgt)
 
     def _note_result_errors(self, results):
         """Tally FDBError entries of a finished result list by class."""
@@ -1047,10 +1111,14 @@ class CommitProxy:
                             systemdata.pack_version(cv),
                         ))
                     results.append(cv)
+                    self._note_tags("committed", getattr(req, "tags", ()))
                 elif st == TOO_OLD:
                     results.append(FDBError.from_name("transaction_too_old"))
                     batch_conflicts += 1
+                    self._note_tags("too_old", getattr(req, "tags", ()))
                 else:
+                    self._note_tags("conflicted", getattr(req, "tags", ()))
+                    self._charge_conflict(req)
                     e = FDBError.from_name("not_committed")
                     if req.report_conflicting_keys:
                         e.conflicting_key_ranges = self._conflicting_ranges(

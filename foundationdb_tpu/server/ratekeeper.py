@@ -57,6 +57,11 @@ class Ratekeeper:
         self.tag_limits = {}  # tag -> tps (auto, AIMD)
         self._tag_buckets = {}  # tag -> [tokens, last_refill]
         self.tag_throttled_count = 0
+        # per-tag busyness (workload attribution, gauge only): the last
+        # completed control window's cnt/total share per tag, captured
+        # BEFORE _update_tags_locked resets its sample — a future
+        # tag-throttle PR turns policy on against exactly this signal
+        self.tag_busyness = {}
         # thread-mode clusters admit from many client threads while the
         # batcher thread feeds observe_commit/update: the token bucket's
         # read-modify-write must not interleave
@@ -250,6 +255,14 @@ class Ratekeeper:
         now = self.clock()
         elapsed = max(now - self._tag_window_start, 1e-9)
         total = self._recent_admits
+        if self._tag_counts:
+            # retain the window's per-tag admission share as a gauge
+            # (the throttle-policy hook documented in analysis/README):
+            # captured here because the sample resets below
+            self.tag_busyness = {
+                tag: round(cnt / max(total, 1), 4)
+                for tag, cnt in sorted(self._tag_counts.items())
+            }
         under_pressure = self.target_tps < self.max_tps * 0.9
         # visit limited-but-silent tags too: a tag that stopped sending
         # must have its limit regrown/released, not kept forever
@@ -317,4 +330,8 @@ class Ratekeeper:
         m.gauge("saturation").set(
             round(1.0 - self.target_tps / max(self.max_tps, 1e-9), 4)
         )
-        return {"alive": True, "metrics": m.snapshot()}
+        doc = {"alive": True, "metrics": m.snapshot()}
+        with self._mu:
+            if self.tag_busyness:
+                doc["tag_busyness"] = dict(self.tag_busyness)
+        return doc

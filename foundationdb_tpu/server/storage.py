@@ -24,6 +24,7 @@ from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.keys import KeySelector, key_successor
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
 from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
+from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -159,6 +160,18 @@ class StorageServer(RangeReadInterface):
         self._m_mutations = self.metrics.counter("mutations_applied")
         self._m_reads = self.metrics.counter("point_reads")
         self._m_range_reads = self.metrics.counter("range_reads")
+        # read/write key sampling (ref: StorageMetrics byte-sampling):
+        # cluster-owned heatmaps attached via attach_heatmaps; None =
+        # sampling off. Countdown sampling — one integer decrement per
+        # access, a "key-sample"-stream draw only when a sample fires —
+        # keeps the hot-path cost inside the heatmap_smoke 2% budget.
+        self._read_heat = None
+        self._write_heat = None
+        self._sample_every = 8
+        self._sample_w = 8.0
+        self._srng = None
+        self._read_cd = 1  # first access sampled: heat appears promptly
+        self._write_cd = 1
 
     @classmethod
     def recover(cls, engine, log_records, window_versions=5_000_000):
@@ -213,6 +226,19 @@ class StorageServer(RangeReadInterface):
             self.version = version
         self._m_apply.record(max(0.0, metrics_mod.now() - t0))
         self._m_mutations.inc(len(mutations))
+        if self._write_heat is not None and mutations:
+            # write sampling stays OUT of the inlined SET loop: one
+            # countdown decrement per apply call, a sampled key drawn
+            # from the batch only when the countdown fires (and the kill
+            # switch checked only then — per fire, not per apply)
+            self._write_cd -= len(mutations)
+            if self._write_cd <= 0:
+                self._write_cd = self._srng.randrange(
+                    1, 2 * self._sample_every + 1)
+                if heatmap_mod.enabled():
+                    m = mutations[self._srng.randrange(len(mutations))]
+                    if m.key < b"\xff":  # user keyspace only (see reads)
+                        self._write_heat.charge(m.key, self._sample_w)
         asp.finish(mutations=len(mutations))
 
     def _apply_clear_range(self, begin, end, version):
@@ -330,6 +356,12 @@ class StorageServer(RangeReadInterface):
     def get(self, key, version):
         self._check_version(version)
         self._m_reads.inc()
+        if self._read_heat is not None:
+            # countdown inlined: the per-read sampling cost is ONE
+            # integer decrement — no function call until a sample fires
+            self._read_cd -= 1
+            if self._read_cd <= 0:
+                self._sample_read(key)
         with self._mu:
             return self._lookup(key, version)
 
@@ -353,6 +385,12 @@ class StorageServer(RangeReadInterface):
         call, so the lock's critical section ends when that call returns
         (CPython closes the abandoned generator at function exit)."""
         self._m_range_reads.inc()
+        if self._read_heat is not None:
+            # a range read charges its begin key: the scan's heat lands
+            # on the range's bucket without touching the merge loop
+            self._read_cd -= 1
+            if self._read_cd <= 0:
+                self._sample_read(begin)
         with self._mu:
             yield from self._iter_live_locked(begin, end, version, reverse)
 
@@ -485,6 +523,36 @@ class StorageServer(RangeReadInterface):
             if self.versioned_engine:
                 with self._mu:
                     self.engine.prune(min(oldest, self.durable_version))
+
+    def attach_heatmaps(self, read_heat, write_heat, sample_every=8):
+        """Wire the cluster-owned read/write heatmaps into this storage
+        (and a recruited replacement: the cluster re-attaches the SAME
+        objects, so per-shard heat survives recruitment like the
+        registry). The sampling stream is the shared deterministic
+        "key-sample" stream — same-seed sims replay the exact draws."""
+        from foundationdb_tpu.core import deterministic
+
+        self._read_heat = read_heat
+        self._write_heat = write_heat
+        self._sample_every = max(1, int(sample_every))
+        self._sample_w = float(self._sample_every)
+        self._srng = deterministic.rng("key-sample")
+
+    def _sample_read(self, key):
+        """Fire path — the countdown hit zero (the decrement lives
+        inline at the read sites). Randomized stride (mean ≈
+        sample_every) instead of a fixed one: periodic access patterns
+        cannot alias with the sampler; weight scales by the rate so heat
+        estimates TOTAL accesses, matching the ref's byte-sample
+        scaling. The kill switch is checked HERE, once per fire, not
+        once per access."""
+        self._read_cd = self._srng.randrange(
+            1, 2 * self._sample_every + 1)
+        # system keys (\xff...) stay out of the workload heatmaps: the
+        # status/metacluster machinery reads them on every poll, and an
+        # observer that heats what it observes would drown user ranges
+        if key < b"\xff" and heatmap_mod.enabled():
+            self._read_heat.charge(key, self._sample_w)
 
     def adopt_metrics(self, registry):
         """Recruitment carryover: the replacement continues the dead

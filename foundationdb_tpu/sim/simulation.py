@@ -91,10 +91,13 @@ class FaultyGrvProxy:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
-    def get_read_version(self, priority="default"):
+    def get_read_version(self, priority="default", tags=()):
+        # tags passthrough (ride-along fix): without it a TAGGED sim
+        # transaction would TypeError here instead of reaching the
+        # ratekeeper's per-tag gate
         if self._buggify("grv_rejected"):
             raise err("process_behind")
-        return self._inner.get_read_version(priority)
+        return self._inner.get_read_version(priority, tags=tags)
 
 
 class Simulation:

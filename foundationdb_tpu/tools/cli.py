@@ -137,6 +137,7 @@ class Cli:
             "  tenant mode [MODE]              optional|required|disabled",
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
+            "  top [conflict|read|write] [K]   hottest key ranges + tags",
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
@@ -547,6 +548,47 @@ class Cli:
         else:
             raise ValueError("usage: throttle list | on tag TAG TPS | "
                              "off tag TAG")
+
+    def _cmd_top(self, args):
+        """Workload attribution (ref: fdbcli's hot-range tooling around
+        StorageMetrics): top-K key ranges by conflict/read/write heat
+        plus per-tag busyness, read through the
+        ``\\xff\\xff/metrics/hot_ranges`` special key so the same
+        command works against remote clusters."""
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        dims = ("conflict", "read", "write")
+        if args and args[0] in dims:
+            dims = (args[0],)
+            args = args[1:]
+        k = int(args[0]) if args else 5
+        doc = json.loads(self._run(lambda tr: tr.get(sk.HOT_RANGES)))
+        if doc.get("sampling") is False:
+            self._p("Workload sampling is disabled")
+            return
+        ranges = doc.get("hot_ranges", {})
+        for dim in dims:
+            rows = sorted(ranges.get(dim, ()),
+                          key=lambda r: -r["heat"])[:k]
+            self._p(f"Hot ranges ({dim}):")
+            if not rows:
+                self._p("  (none sampled)")
+                continue
+            for r in rows:
+                begin = format_key(r["begin"].encode("latin-1"))
+                end = (format_key(r["end"].encode("latin-1"))
+                       if r["end"] is not None else "<end>")
+                self._p(f"  [{begin}, {end}): {r['heat']}")
+        tags = doc.get("tags", {})
+        if tags:
+            self._p("Tags:")
+            for tag, row in sorted(tags.items()):
+                fields = ", ".join(
+                    f"{f}={row[f]}" for f in
+                    ("started", "committed", "conflicted", "too_old",
+                     "busyness") if f in row
+                )
+                self._p(f"  {tag}: {fields}")
 
 
 def main(argv=None):

@@ -1,0 +1,124 @@
+r"""Split-point advice from the workload heatmaps.
+
+Ref parity: the DD split-point machinery around
+fdbserver/StorageMetrics.actor.cpp — the reference picks shard split
+keys at byte-sample quantiles so each side carries equal load. Here the
+input is the cluster's workload-attribution document (the
+``\xff\xff/metrics/hot_ranges`` special key / ``metrics hot`` RPC /
+``cluster.workload.hot_ranges`` in status): decayed hot-range
+histograms per dimension (conflict / read / write). Advice = the keys
+where CUMULATIVE heat crosses the i/n quantiles, i.e. split points
+that would spread the observed heat evenly across n shards.
+
+Usage::
+
+    from foundationdb_tpu.tools import heatmap as hm
+    hm.split_advice(cluster.hot_ranges_status(), n=4, dim="read")
+
+or, against a served cluster::
+
+    python -m foundationdb_tpu.tools.heatmap --cluster-file fdb.cluster \
+        --dim conflict -n 4
+
+(with ``--json -`` the document is read from stdin instead — pipe a
+saved ``\xff\xff/metrics/hot_ranges`` value in).
+"""
+
+import json
+import sys
+
+
+def split_points_from_rows(rows, n):
+    """Split keys (str) at cumulative-heat quantiles over snapshot
+    ``rows`` ([{begin, end, heat}, ...], sorted by begin — the exact
+    shape KeyRangeHeatmap.snapshot() emits). Returns at most n-1 keys;
+    consecutive duplicates (one range hot enough to span several
+    quantiles) are collapsed, matching KeyRangeHeatmap.split_points."""
+    if n <= 1 or not rows:
+        return []
+    total = sum(r["heat"] for r in rows)
+    if total <= 0:
+        return []
+    # the exact algorithm KeyRangeHeatmap.split_points runs over its
+    # anchors: cut at the first range whose START sits at-or-past each
+    # i/n cumulative-heat quantile (so the first range's begin — a
+    # no-op split — is never advised)
+    points = []
+    acc = 0.0
+    targets = [total * q / n for q in range(1, n)]
+    ti = 0
+    for r in rows:
+        while ti < len(targets) and acc >= targets[ti]:
+            key = r["begin"]
+            if not points or points[-1] != key:
+                points.append(key)
+            ti += 1
+        acc += r["heat"]
+    return points
+
+
+def split_advice(doc, n=4, dim="read"):
+    """Advice record for one dimension of a workload-attribution
+    document: the suggested split keys plus the heat each resulting
+    shard would carry (so an operator can see HOW uneven the current
+    layout is versus the advised one)."""
+    rows = (doc.get("hot_ranges") or {}).get(dim) or []
+    points = split_points_from_rows(rows, n)
+    # heat per advised shard: rows partitioned at the split keys
+    shards = []
+    acc = 0.0
+    pi = 0
+    for r in rows:
+        while pi < len(points) and r["begin"] >= points[pi]:
+            shards.append(round(acc, 4))
+            acc = 0.0
+            pi += 1
+        acc += r["heat"]
+    shards.append(round(acc, 4))
+    while pi < len(points):  # trailing empty shards (dup-collapsed tail)
+        shards.append(0.0)
+        pi += 1
+    return {
+        "dim": dim,
+        "n": n,
+        "total_heat": round(sum(r["heat"] for r in rows), 4),
+        "split_points": points,
+        "shard_heat": shards,
+    }
+
+
+def _fetch_doc(ns):
+    if ns.json == "-":
+        return json.load(sys.stdin)
+    if ns.json:
+        with open(ns.json) as f:
+            return json.load(f)
+    from foundationdb_tpu.rpc.service import RemoteCluster
+
+    rc = RemoteCluster.from_cluster_file(ns.cluster_file)
+    try:
+        return rc.hot_ranges_status()
+    finally:
+        rc.close()
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="heatmap", description="hot-range split-point advice")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--cluster-file", help="cluster to poll")
+    src.add_argument("--json", help="saved hot_ranges document (- = stdin)")
+    ap.add_argument("--dim", default="read",
+                    choices=("conflict", "read", "write"))
+    ap.add_argument("-n", type=int, default=4,
+                    help="target shard count (n-1 split points)")
+    ns = ap.parse_args(argv)
+    advice = split_advice(_fetch_doc(ns), n=ns.n, dim=ns.dim)
+    print(json.dumps(advice, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

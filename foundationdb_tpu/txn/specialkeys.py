@@ -34,6 +34,9 @@ def contains(key):
 
 STATUS_JSON = b"\xff\xff/status/json"
 METRICS_JSON = b"\xff\xff/metrics/json"
+# workload attribution (utils/heatmap.py): fleet-merged conflict/read/
+# write hot ranges + per-tag rollup, without the rest of the status doc
+HOT_RANGES = b"\xff\xff/metrics/hot_ranges"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
@@ -92,6 +95,21 @@ def _metrics_json(tr):
     return json.dumps(doc, sort_keys=True).encode()
 
 
+def _hot_ranges_json(tr):
+    """The workload-attribution document alone (hot ranges + tags) —
+    what `fdbcli top` and tools/heatmap.py poll."""
+    cluster = tr._cluster
+    if hasattr(cluster, "hot_ranges_status"):
+        doc = cluster.hot_ranges_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        w = tr.db.status().get("cluster", {}).get("workload", {})
+        doc = {"sampling": None,
+               "hot_ranges": w.get("hot_ranges", {}),
+               "totals": w.get("hot_range_totals", {}),
+               "tags": w.get("tags", {})}
+    return json.dumps(doc, sort_keys=True).encode()
+
+
 def _tracing_rows(tr):
     """The tracing module's materialized rows (cluster config + this
     transaction's token), RYW-overlaid with pending tracing writes."""
@@ -139,6 +157,8 @@ def get(tr, key):
         return json.dumps(tr.db.status(), sort_keys=True).encode()
     if key == METRICS_JSON:
         return _metrics_json(tr)
+    if key == HOT_RANGES:
+        return _hot_ranges_json(tr)
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
     if key == DB_LOCKED:
@@ -173,6 +193,8 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         rows.append((STATUS_JSON, get(tr, STATUS_JSON)))
     if begin <= METRICS_JSON < end:
         rows.append((METRICS_JSON, get(tr, METRICS_JSON)))
+    if begin <= HOT_RANGES < end:
+        rows.append((HOT_RANGES, get(tr, HOT_RANGES)))
     if begin <= CONNECTION_STRING < end:
         rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
     rows += [

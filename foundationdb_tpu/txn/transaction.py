@@ -78,6 +78,14 @@ class TransactionOptions:
                 raise err("invalid_option_value")
             self._tr._tags.append(tag)
 
+    def set_auto_throttle_tag(self, tag):
+        """Ref: AUTO_THROTTLE_TAG — same tag semantics as set_tag, but
+        the tag is additionally eligible for ratekeeper AUTO throttling
+        (here every tag already is: the ratekeeper auto-throttle
+        samples all tagged traffic, so this is an alias kept for API
+        parity with the reference bindings)."""
+        self.set_tag(tag)
+
     def set_retry_limit(self, n):
         self._tr._retry_limit = int(n)
 
@@ -718,6 +726,7 @@ class Transaction:
             idempotency_id=idmp,
             flat_conflicts=flat,
             span_context=sctx,
+            tags=tuple(self._tags),
         )
 
     def _ensure_idempotency_id(self):
@@ -964,12 +973,12 @@ class Transaction:
         keep = (self._retries, self._backoff, self._retry_limit,
                 self._max_retry_delay, self._timeout_s,
                 self._idempotency_id, self._auto_idempotency,
-                self._trace_forced)
+                self._trace_forced, self._tags)
         self._reset()
         (self._retries, self._backoff, self._retry_limit,
          self._max_retry_delay, self._timeout_s,
          self._idempotency_id, self._auto_idempotency,
-         self._trace_forced) = keep
+         self._trace_forced, self._tags) = keep
 
     def reset(self):
         self._reset()

@@ -30,6 +30,9 @@ def test_compact_summary_is_small_and_headline_last():
         "pack_reuse_rate": 0.99,
         # commit/GRV latency bands from the metrics subsystem (ISSUE 4)
         "commit_p50_ms": 1.1, "commit_p99_ms": 3.2, "grv_p99_ms": 0.4,
+        # workload attribution (ISSUE 8)
+        "hot_range_buckets": 192, "hot_range_top_conflict": "user42",
+        "tags_seen": 1,
         # static-analysis debt (analysis/flowlint.py): 0 must still ride
         "flowlint_findings": 0,
     }
@@ -62,6 +65,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["pack_reuse_rate"] == 0.99
     # lint debt rides the summary — and a clean tree's 0 is not dropped
     assert line["flowlint_findings"] == 0
+    # workload attribution rides the summary: bucket bound + hottest
+    # conflict range + tag count are tracked numbers per run
+    assert line["hot_range_buckets"] == 192
+    assert line["hot_range_top_conflict"] == "user42"
+    assert line["tags_seen"] == 1
     # the measured commit/GRV latency bands ride the summary: the
     # <2ms-added-p99 target is a tracked number, not prose
     assert line["commit_p50_ms"] == 1.1
@@ -128,9 +136,19 @@ def test_e2e_line_folds_proxies_and_platform():
                 "e2e_repair_enabled", "e2e_sched_enabled",
                 "e2e_retry_mode", "repair_attempts", "repair_commits",
                 "repair_fallbacks", "repair_rate",
-                "sched_batches", "sched_reordered", "sched_deferred"):
+                "sched_batches", "sched_reordered", "sched_deferred",
+                # workload attribution (ISSUE 8): every line carries
+                # the hot-range/tag gauges and the sampling state
+                "hot_range_buckets", "hot_range_top_conflict",
+                "hot_range_top_read", "hot_range_top_write",
+                "hot_range_conflict_heat", "tags_seen", "tag_busiest",
+                "workload_sampling"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
+    # workload sampling is default-ON and the tagged client was counted
+    assert fields["workload_sampling"] is True
+    assert fields["tags_seen"] >= 1
+    assert fields["hot_range_buckets"] >= 1
     # repair/scheduling default OFF: the gauges must say so explicitly
     assert fields["e2e_repair_enabled"] is False
     assert fields["e2e_sched_enabled"] is False
@@ -166,6 +184,32 @@ def test_metrics_smoke_contract():
     from foundationdb_tpu.utils import metrics as metrics_mod
 
     assert metrics_mod.enabled()
+
+
+def test_heatmap_smoke_contract():
+    """BENCH_MODE=heatmap_smoke: the workload-attribution overhead
+    probe emits the budget fields plus the hot-range/tag gauges from
+    the enabled arm, and restores the kill switch. One short round
+    checks the contract; the bench run owns the statistically serious
+    comparison."""
+    out = bench.run_heatmap_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "heatmap_overhead_pct", "overhead_budget_pct",
+                "within_budget", "hot_range_buckets",
+                "hot_range_top_conflict", "hot_range_top_read",
+                "hot_range_conflict_heat", "tags_seen", "tag_busiest",
+                "commit_p50_ms", "commit_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_heatmap_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the enabled arm really sampled: buckets exist and the ycsb client
+    # tag was attributed end to end
+    assert out["hot_range_buckets"] >= 1
+    assert out["tags_seen"] >= 1
+    # the probe restored the kill switch (sampling stays default-on)
+    from foundationdb_tpu.utils import heatmap as heatmap_mod
+
+    assert heatmap_mod.enabled()
 
 
 def test_tracing_smoke_contract():

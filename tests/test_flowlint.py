@@ -478,3 +478,34 @@ def test_cli_end_to_end(tmp_path, capsys):
     assert flowlint.main(
         [root, "--baseline", str(baseline), "--no-baseline"]
     ) == 1
+
+
+# ─────────────── FL001: the key-sampling path (ISSUE 8) ───────────────
+def test_fl001_flags_raw_entropy_in_key_sampling():
+    """The storage key-sampler's countdown draws MUST ride the seeded
+    key-sample stream: raw stdlib draws here would make two same-seed
+    sims emit different hot-range snapshots."""
+    findings = lint("server/storage.py", """
+        import random
+
+        def _sample_read(self, key):
+            self._read_cd = random.randrange(1, 2 * self._sample_every + 1)
+            if random.random() < 0.5:
+                self._read_heat.charge(key, self._sample_w)
+    """)
+    assert rules_of(findings) == ["FL001"] * 2
+
+
+def test_fl001_allows_key_sample_stream_sampling():
+    findings = lint("server/storage.py", """
+        from foundationdb_tpu.core import deterministic
+
+        def attach_heatmaps(self):
+            self._srng = deterministic.rng("key-sample")
+
+        def _sample_read(self, key):
+            self._read_cd = self._srng.randrange(
+                1, 2 * self._sample_every + 1)
+            self._read_heat.charge(key, self._sample_w)
+    """)
+    assert findings == []
