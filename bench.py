@@ -721,6 +721,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     # workload attribution (utils/heatmap.py): the heatmaps are
     # cluster-owned, so like the registries they outlive close()
     hot = cluster.hot_ranges_status()
+    # device-path profile (utils/deviceprofile.py): cluster-owned like
+    # the registries/heatmaps; the aggregate snapshot feeds the e2e line
+    dev = cluster.device_profile_status()["aggregate"]
 
     def _hottest(dim):
         rows = hot["hot_ranges"].get(dim) or ()
@@ -786,6 +789,17 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "tag_busiest_busyness": (
             tags[busiest].get("busyness") if busiest else None),
         "workload_sampling": hot["sampling"],
+        # device-path execution profile: pad/bucket occupancy, compile
+        # events, fallback-cause taxonomy and lane skew on every e2e
+        # line — the inputs tools/benchdiff.py tracks across rounds
+        "pad_waste_pct": dev["pad_waste_pct"],
+        "bucket_histogram": dev["bucket_histogram"],
+        "recompiles": dev["recompiles"],
+        "fallback_causes": dev["fallback_causes"],
+        "lane_skew_pct": dev["lane_skew_pct"],
+        "device_dispatches": dev["dispatches"],
+        "staging_reuse_rate": dev["staging_reuse_rate"],
+        "transfer_bytes": dev["transfer_bytes"],
         # distributed tracing: how many transactions carried a sampled
         # trace this run (0 when the knob is off — the field rides
         # every line so its absence is never ambiguous)
@@ -1260,8 +1274,36 @@ def run_kernel_bench(point, cpu, fallback_note):
     return out
 
 
+# bench-line schema revision: bump when e2e-line/summary field names
+# change meaning, so tools/benchdiff.py can refuse (or annotate) a
+# cross-schema comparison instead of silently diffing renamed fields
+SCHEMA_REV = 2
+
+_GIT_REV = None
+
+
+def _provenance():
+    """``schema_rev`` + the repo's short git rev, stamped at the FRONT
+    of every emitted JSON line (insertion order = a header), so a
+    BENCH_r* round is self-describing about which code produced it.
+    Git may be absent/broken in a stripped container — that is an
+    "n/a", never a crash."""
+    global _GIT_REV
+    if _GIT_REV is None:
+        try:
+            import subprocess
+            _GIT_REV = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or "n/a"
+        except Exception:
+            _GIT_REV = "n/a"
+    return {"schema_rev": SCHEMA_REV, "git_rev": _GIT_REV}
+
+
 def _emit(out):
-    print(json.dumps(out), flush=True)
+    print(json.dumps({**_provenance(), **out}), flush=True)
 
 
 def _e2e_line(cpu, metric, vs_of=BASELINE_TXNS_PER_SEC,
@@ -1657,6 +1699,66 @@ def run_heatmap_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_profile_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=profile_smoke: the device-path execution profiler's
+    overhead budget, measured — the ycsb e2e with the deviceprofile
+    kill switch ON (dispatch accounting, compile-cache observation,
+    staging/fallback hooks live) vs OFF, interleaved pairs, median
+    throughput each, ≤2% budget (the metrics_smoke protocol). The
+    enabled arm's profiler fields ride along so the smoke also proves
+    the dispatch accounting populated under the measured load."""
+    from foundationdb_tpu.utils import deviceprofile as dev_mod
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    backend = "native"
+    runs = {True: [], False: []}
+    fields_on = None
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                dev_mod.set_enabled(on)
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    fields_on = r
+    finally:
+        dev_mod.set_enabled(True)
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_profile_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "profile_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": fields_on.get("platform"),
+        "pad_waste_pct": fields_on.get("pad_waste_pct"),
+        "bucket_histogram": fields_on.get("bucket_histogram"),
+        "recompiles": fields_on.get("recompiles"),
+        "fallback_causes": fields_on.get("fallback_causes"),
+        "lane_skew_pct": fields_on.get("lane_skew_pct"),
+        "device_dispatches": fields_on.get("device_dispatches"),
+        "staging_reuse_rate": fields_on.get("staging_reuse_rate"),
+        "commit_p50_ms": fields_on.get("commit_p50_ms"),
+        "commit_p99_ms": fields_on.get("commit_p99_ms"),
+    }
+
+
 def run_tracing_smoke(cpu, seconds=None, rounds=None, rate=None):
     """BENCH_MODE=tracing_smoke: the distributed-tracing overhead
     budget, measured — the ycsb e2e with tracing at the DEFAULT enabled
@@ -1862,10 +1964,17 @@ def _compact_summary(out, configs):
               "pipeline_depth_effective", "pack_path", "pack_bytes",
               "pack_reuse_rate", "spans_sampled", "repair_rate",
               "hot_range_buckets", "hot_range_top_conflict", "tags_seen",
+              "pad_waste_pct", "bucket_histogram", "recompiles",
+              "fallback_causes", "lane_skew_pct",
               "flowlint_findings",
               "tpu_recovered", "fallback_from", "error"):
         if out.get(k) is not None:
             line[k] = out[k]
+    # the fallback taxonomy is 5 fixed keys; the compact line keeps
+    # only the causes that actually fired (zeros cost tail bytes)
+    if isinstance(line.get("fallback_causes"), dict):
+        line["fallback_causes"] = {
+            k: v for k, v in line["fallback_causes"].items() if v}
     line["configs"] = cfg
     line["metric"] = out["metric"]
     line["value"] = out["value"]
@@ -1900,6 +2009,8 @@ def main():
     # restart-only baseline on the contended tpcc shape) |
     # heatmap_smoke (workload-attribution overhead: heatmap kill switch
     # on vs off, ≤2% budget) |
+    # profile_smoke (device-path execution profiler overhead: the
+    # deviceprofile kill switch on vs off, ≤2% budget) |
     # sharded_e2e (internal: the multilane re-exec child)
     # only the default multi-config run plans recovery re-execs, so only
     # it earns the wider deadline (worst case 60+500+120+650s of
@@ -1983,6 +2094,15 @@ def main():
 
     if mode == "heatmap_smoke":
         out = run_heatmap_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # same contract as metrics_smoke: the ≤2% budget is a GATE
+        if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "profile_smoke":
+        out = run_profile_smoke(cpu)
         watchdog_finish()
         _emit(out)
         # same contract as metrics_smoke: the ≤2% budget is a GATE

@@ -671,6 +671,32 @@ def make_resolve_scan_fn(params: ResolverParams, donate=True,
     return jax.jit(scan_step, donate_argnums=(0,) if donate else ())
 
 
+def count_retraces(fn, on_retrace, gate=None):
+    """HOST-side compile-cache observer: wrap a jitted dispatch callable
+    so every NEW argument shape/dtype signature fires ``on_retrace(sig)``
+    once — a new signature is exactly what forces XLA to retrace and
+    recompile. The check runs around the jit call (never inside the
+    traced region — FL004), costs one tree-leaves walk per dispatch, and
+    is skipped entirely while ``gate()`` is falsy (the profiler kill
+    switch), so the disabled arm of the overhead smoke pays nothing but
+    the gate call."""
+    seen = set()
+
+    def wrapped(*args):
+        if gate is None or gate():
+            sig = tuple(
+                (tuple(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in jax.tree.leaves(args)
+            )
+            if sig not in seen:
+                seen.add(sig)
+                on_retrace(sig)
+        return fn(*args)
+
+    return wrapped
+
+
 def rebase_state(state: ResolverState, delta):
     """Shift all version offsets down by ``delta`` (saturating at 0).
 

@@ -41,6 +41,19 @@ def default_mesh(n_devices=None):
     return Mesh(np.array(devs), (AXIS,))
 
 
+def lane_shards(arr):
+    """The per-device shards of ``arr`` in stable lane order (device
+    id) — the HOST-side handle set the device profiler blocks one by
+    one to measure per-lane dispatch wall (utils/deviceprofile.py).
+    Empty for values without addressable shards (plain numpy, tracers),
+    so callers can no-op on host backends."""
+    try:
+        shards = arr.addressable_shards
+    except AttributeError:
+        return []
+    return sorted(shards, key=lambda s: s.device.id)
+
+
 def _state_specs(axes=AXIS):
     return ck.ResolverState(
         window_start=P(),  # replicated scalar

@@ -35,6 +35,7 @@ from foundationdb_tpu.resolver.resolver import (
     fast_params_of,
     params_from_knobs,
 )
+from foundationdb_tpu.utils import deviceprofile
 
 
 class MeshResolver(Resolver):
@@ -60,6 +61,7 @@ class MeshResolver(Resolver):
         self.base_version = base_version
         self.alive = True
         self._init_metrics()
+        self.profile = deviceprofile.DeviceProfile("resolver")
         self.wants_point_split = True
         self.accepts_flat = True  # same packer machinery as Resolver
         self.dispatch_wall_s = 0.0
@@ -74,6 +76,10 @@ class MeshResolver(Resolver):
                 TraceEvent("ResolverLanesClamped", severity=30).detail(
                     requested=n_lanes, lanes=n,
                     devices=len(jax.devices())).log()
+                # the structured taxonomy's sharded_to_local cause: the
+                # operator asked for a fleet the hardware can't host
+                self.profile.record_fallback("sharded_to_local",
+                                             n_lanes - n)
             mesh = default_mesh(n)
         self.mesh = mesh
         self.n_lanes = int(mesh.devices.size)
@@ -109,10 +115,33 @@ class MeshResolver(Resolver):
             (2, 4, BACKLOG_B)
             if jax.default_backend() == "cpu" else (BACKLOG_B,)
         )
+        self.adopt_profile(self.profile)  # attach the packer hooks
 
     def _make_scan_fn(self, use_fast):
         kernel = self._fast_kernel if use_fast else self._kernel
         return kernel._scan_step
+
+    def _profile_lanes(self, statuses):
+        """Per-lane dispatch wall for one mesh dispatch (ROADMAP item
+        4's lane-utilization skew, measured). The verdicts are
+        replicated (out_spec P()), so every lane holds its own finished
+        copy: blocking each lane's shard in stable device order and
+        timestamping its completion gives per-lane walls host-side —
+        a straggler lane stretches its entry, balanced lanes land
+        together. HOST-side only (materialize time, FL004-clean)."""
+        if not deviceprofile.enabled():
+            return
+        from foundationdb_tpu.parallel.mesh import lane_shards
+
+        shards = lane_shards(statuses)
+        if len(shards) <= 1:
+            return
+        t0 = deviceprofile.now()
+        walls = []
+        for s in shards:
+            s.data.block_until_ready()
+            walls.append(deviceprofile.now() - t0)
+        self.profile.record_lanes(walls)
 
     def respawn(self, base_version):
         """Recruitment: a fresh fleet on the same mesh, fenced (the
@@ -120,5 +149,6 @@ class MeshResolver(Resolver):
         new = MeshResolver(self.knobs, base_version=base_version,
                            mesh=self.mesh)
         new._init_metrics(self.metrics)
+        new.adopt_profile(self.profile)
         new._m_respawns.inc()
         return new

@@ -70,6 +70,10 @@ class BatchPacker:
         self._zero_hash = None  # fnv of an all-zero key row (cached)
         self.flat_reuse_hits = 0
         self.flat_reuse_misses = 0
+        # device-path profiler hook (utils/deviceprofile.py): the
+        # owning resolver attaches its DeviceProfile so staging-ring
+        # reuse-vs-realloc events land in the cluster.device doc
+        self.profile = None
         if use_native and params.key_width - 1 <= 16:
             from foundationdb_tpu.native import load_packer
 
@@ -108,6 +112,8 @@ class BatchPacker:
             )[0]
         if len(ring) < self.STAGING_RING:
             self.flat_reuse_misses += 1
+            if self.profile is not None:
+                self.profile.record_staging(hit=False)
             T, W = p.txns, p.key_width
             bufs = {
                 "rv": np.zeros((B, T), np.uint32),
@@ -140,6 +146,8 @@ class BatchPacker:
         i = self._flat_ring_next[B]
         self._flat_ring_next[B] = (i + 1) % len(ring)
         self.flat_reuse_hits += 1
+        if self.profile is not None:
+            self.profile.record_staging(hit=True)
         bufs = ring[i]
         for name, a in bufs.items():
             if name in ("pr_hash", "pw_hash"):

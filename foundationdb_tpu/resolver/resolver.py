@@ -19,6 +19,7 @@ from foundationdb_tpu.core.options import DEFAULT_KNOBS
 from foundationdb_tpu.ops import conflict as ck
 from foundationdb_tpu.resolver.packing import BatchPacker
 from foundationdb_tpu.resolver.skiplist import CpuConflictSet
+from foundationdb_tpu.utils import deviceprofile
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -131,6 +132,11 @@ class Resolver:
         # subtracts this from its stage-A+B timer so stage_pack_ms
         # measures HOST PACKING and stage_dispatch_ms the dispatch
         self.dispatch_wall_s = 0.0
+        # device-path profiler (utils/deviceprofile.py): per-dispatch
+        # pad/bucket/fallback accounting. The cluster hands every
+        # resolver its cluster-owned DeviceProfile via adopt_profile
+        # (the PR-4 registry pattern) so history survives respawn.
+        self.profile = deviceprofile.DeviceProfile("resolver")
         # The device kernel has dedicated point LANES, and the native
         # conflict set packs a split-out point key once with its end
         # span aliasing the same blob bytes — both want the proxy's
@@ -199,6 +205,24 @@ class Resolver:
                 self.cset.resolve([], 0, base_version)
         else:
             raise ValueError(f"unknown resolver_backend {self.backend!r}")
+        self.adopt_profile(self.profile)  # attach the packer hooks
+
+    def adopt_profile(self, profile):
+        """Adopt a cluster-owned :class:`DeviceProfile` (the registry
+        carryover pattern): fold whatever this instance already recorded
+        into it, then point every capture site — including the packers'
+        staging-ring hooks — at the shared object, so device-path
+        history survives respawn / recovery / configure."""
+        if profile is not getattr(self, "profile", None):
+            mine = getattr(self, "profile", None)
+            if mine is not None:
+                profile.absorb(mine)
+            self.profile = profile
+        for p in (getattr(self, "packer", None),
+                  self._fast[0] if getattr(self, "_fast", None) else None):
+            if p is not None:
+                p.profile = self.profile
+        return self.profile
 
     def _init_metrics(self, registry=None):
         """Build (or adopt) the role registry + hot-path handles.
@@ -239,6 +263,7 @@ class Resolver:
         subclasses recruit their own shape)."""
         new = type(self)(self.knobs, base_version=base_version)
         new._init_metrics(self.metrics)
+        new.adopt_profile(self.profile)
         new._m_respawns.inc()
         return new
 
@@ -278,7 +303,16 @@ class Resolver:
             return self._resolve_flat(txns, commit_version,
                                       new_window_start)
         if self.backend in ("cpu", "native"):
-            return self.cset.resolve(txns, commit_version, new_window_start)
+            prof = deviceprofile.enabled()
+            pt0 = deviceprofile.now() if prof else 0.0
+            out = self.cset.resolve(txns, commit_version, new_window_start)
+            if prof:
+                # host sets pack nothing: slots == live, zero pad waste
+                self.profile.record_dispatch(
+                    bucket=1, live_batches=1, live_txns=len(txns),
+                    txn_slots=len(txns),
+                    wall_s=deviceprofile.now() - pt0)
+            return out
         self._maybe_rebase(commit_version)
         # base_version only ever advances to a past window start, so a read
         # version below it is too old by construction — reject on host
@@ -300,8 +334,20 @@ class Resolver:
             batch = packer.pack(
                 [t for _, t in chunk], self.base_version, commit_version, new_window_start
             )
+            prof = deviceprofile.enabled()
+            pt0 = deviceprofile.now() if prof else 0.0
             out = self._step_kernel(resolve_fn, batch, len(chunk),
                                     commit_version)
+            if prof:
+                # each chunk is one device step padded to a full
+                # params.txns batch — the single-batch route's pad waste
+                pp = self._fast_params if use_fast else self.params
+                self.profile.record_dispatch(
+                    bucket=1, live_batches=1, live_txns=len(chunk),
+                    txn_slots=pp.txns,
+                    transfer_bytes=sum(
+                        int(x.nbytes) for x in jax.tree.leaves(batch)),
+                    wall_s=deviceprofile.now() - pt0)
             if out is None:  # pallas fallback engaged: fenced restart
                 for j in range(len(statuses)):
                     if statuses[j] is None:
@@ -340,6 +386,7 @@ class Resolver:
             TraceEvent("PallasRingFallback", severity=30).detail(
                 fenced_at=commit_version).log()
             self._m_pallas_fallbacks.inc()
+            self.profile.record_fallback("pallas_to_jit")
             self.params = self.params._replace(use_pallas=False)
             self._resolve = ck.make_resolve_fn(self.params)
             self.state = ck.init_state(self.params)
@@ -353,17 +400,26 @@ class Resolver:
         width mismatch, lane overflow, a too-old read version that the
         host must pre-filter — decodes to TxnRequests and rides the
         legacy path (rare by construction)."""
-        if self.backend == "native":
-            return self.cset.resolve_flat(flat, commit_version,
-                                          new_window_start)
-        if self.backend == "cpu":
-            return self.cset.resolve(flat.to_txn_requests(),
-                                     commit_version, new_window_start)
+        if self.backend in ("native", "cpu"):
+            prof = deviceprofile.enabled()
+            pt0 = deviceprofile.now() if prof else 0.0
+            if self.backend == "native":
+                out = self.cset.resolve_flat(flat, commit_version,
+                                             new_window_start)
+            else:
+                out = self.cset.resolve(flat.to_txn_requests(),
+                                        commit_version, new_window_start)
+            if prof:
+                self.profile.record_dispatch(
+                    bucket=1, live_batches=1, live_txns=len(flat),
+                    txn_slots=len(flat),
+                    wall_s=deviceprofile.now() - pt0)
+            return out
         self._maybe_rebase(commit_version)
-        if not self.packer.flat_fits(flat) or (
-            len(flat) and int(flat.rv.min()) < self.base_version
-        ):
+        cause = self._flat_fallback_cause(flat)
+        if cause is not None:
             self._m_flat_fallbacks.inc()
+            self.profile.record_fallback(cause)
             return self.resolve(flat.to_txn_requests(), commit_version,
                                 new_window_start)
         use_fast = self._pick_fast_flat([flat])
@@ -372,11 +428,53 @@ class Resolver:
         )
         batch = packer.pack_flat(flat, self.base_version, commit_version,
                                  new_window_start)
+        prof = deviceprofile.enabled()
+        pt0 = deviceprofile.now() if prof else 0.0
         out = self._step_kernel(resolve_fn, batch, len(flat),
                                 commit_version)
+        if prof:
+            pp = self._fast_params if use_fast else self.params
+            self.profile.record_dispatch(
+                bucket=1, live_batches=1, live_txns=len(flat),
+                txn_slots=pp.txns,
+                entries_live={"pr": int(flat.prc.sum()),
+                              "pw": int(flat.pwc.sum()),
+                              "rr": int(flat.rrc.sum()),
+                              "rw": int(flat.rwc.sum())},
+                entry_slots={"pr": pp.txns * pp.point_reads,
+                             "pw": pp.txns * pp.point_writes,
+                             "rr": pp.txns * pp.range_reads,
+                             "rw": pp.txns * pp.range_writes},
+                transfer_bytes=sum(
+                    int(x.nbytes) for x in jax.tree.leaves(batch)),
+                wall_s=deviceprofile.now() - pt0)
         if out is None:
             return [TOO_OLD] * len(flat)
         return out
+
+    def _flat_fallback_cause(self, flat):
+        """Why this flat batch cannot ride the columnar lane — the
+        structured fallback_cause taxonomy behind the bare
+        flat_fallbacks counter. None when it can: the predicate is
+        exactly ``flat_fits and rv fresh`` (the legacy-route guard)."""
+        if len(flat) and int(flat.rv.min()) < self.base_version:
+            return "too_old_rv"
+        if self.packer.flat_fits(flat):
+            return None
+        p = self.params
+        if (len(flat) > p.txns
+                or flat.prc.max(initial=0) > p.point_reads
+                or flat.pwc.max(initial=0) > p.point_writes
+                or flat.rrc.max(initial=0) > p.range_reads
+                or flat.rwc.max(initial=0) > p.range_writes):
+            return "over_capacity"
+        return "flat_to_legacy"  # limb-width mismatch
+
+    def _profile_lanes(self, statuses):
+        """Per-lane dispatch-wall capture hook, called host-side at
+        materialize time (never inside a traced fn — FL004). The
+        single-device resolver is one implicit lane: nothing to record;
+        MeshResolver overrides with the per-shard walls."""
 
     def _pick_fast(self, txns):
         """Whether the point-specialized variant may serve these txns
@@ -455,12 +553,14 @@ class Resolver:
         return handle if lazy else handle.wait()
 
     def _dispatch_many(self, batches):
+        import time as _time
+
         if (self.backend != "tpu" or len(batches) <= 1
                 or any(len(t) > self.params.txns for t, _, _ in batches)):
             # host backends / degenerate backlogs resolve eagerly — the
-            # handle is already settled
-            import time as _time
-
+            # handle is already settled. The per-batch resolve() calls
+            # own the dispatch accounting (one record per kernel step /
+            # host scan), so nothing records here.
             t0 = _time.perf_counter()
             result = [self.resolve(t, cv, ws) for t, cv, ws in batches]
             self.dispatch_wall_s += _time.perf_counter() - t0
@@ -484,17 +584,33 @@ class Resolver:
         # here (the eager/host route above counts via resolve itself)
         self._m_batches.inc(len(batches))
         self._m_txns.inc(sum(len(t) for t, _, _ in batches))
+        flats_present = any(
+            isinstance(t, FlatTxnBatch) for t, _, _ in batches)
         if all(isinstance(t, FlatTxnBatch) for t, _, _ in batches):
             handle = self._dispatch_flat(batches)
             if handle is not None:
                 return handle
             self._m_flat_fallbacks.inc()
-        # a mixed or flat-ineligible backlog decodes to the legacy path
+            self.profile.record_fallback(next(
+                (c for c in (self._flat_fallback_cause(t)
+                             for t, _, _ in batches) if c),
+                "flat_to_legacy"))
+        elif flats_present:
+            # flat batches interleaved with legacy requests: the whole
+            # group must decode (one scan threads one history)
+            self.profile.record_fallback("flat_to_legacy")
+        # A mixed or flat-ineligible backlog decodes to the legacy path.
+        # The decode is DISPATCH work: charge it to dispatch_wall_s so
+        # the batcher's stage split doesn't land it in whichever stage
+        # timer happens to be open (stage_pack_ms, before this fix).
+        t_dec = _time.perf_counter()
         batches = [
             (t.to_txn_requests() if isinstance(t, FlatTxnBatch) else t,
              cv, ws)
             for t, cv, ws in batches
         ]
+        if flats_present:
+            self.dispatch_wall_s += _time.perf_counter() - t_dec
         per_batch = []
         all_live = []
         for txns, cv, ws in batches:
@@ -525,20 +641,41 @@ class Resolver:
         if len(packed) < B:
             pad = packer.pack_empty(self.base_version, last_cv, last_ws)
             packed.extend([pad] * (B - len(packed)))
-        key = (use_fast, B)
-        scan_fn = self._scan_fns.get(key)
-        if scan_fn is None:
-            scan_fn = self._make_scan_fn(use_fast)
-            self._scan_fns[key] = scan_fn
+        scan_fn = self._get_scan_fn(use_fast, B)
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *packed)
-        import time as _time
-
+        prof = deviceprofile.enabled()
+        if prof:
+            ent = {"pr": 0, "pw": 0, "rr": 0, "rw": 0}
+            for t in all_live:
+                ent["pr"] += len(t.point_reads)
+                ent["pw"] += len(t.point_writes)
+                ent["rr"] += len(t.range_reads)
+                ent["rw"] += len(t.range_writes)
+            pp = self._fast_params if use_fast else self.params
+            xfer = sum(int(x.nbytes) for x in jax.tree.leaves(stacked))
+            pt0 = deviceprofile.now()
         t0 = _time.perf_counter()
         self.state, st = scan_fn(self.state, stacked)
         self.dispatch_wall_s += _time.perf_counter() - t0
+        if prof:
+            self.profile.record_dispatch(
+                bucket=B, live_batches=len(per_batch),
+                live_txns=len(all_live), txn_slots=B * pp.txns,
+                entries_live=ent,
+                entry_slots={"pr": B * pp.txns * pp.point_reads,
+                             "pw": B * pp.txns * pp.point_writes,
+                             "rr": B * pp.txns * pp.range_reads,
+                             "rw": B * pp.txns * pp.range_writes},
+                transfer_bytes=xfer,
+                wall_s=deviceprofile.now() - pt0)
 
         def materialize():
+            self._profile_lanes(st)
+            rt0 = deviceprofile.now() if deviceprofile.enabled() else 0.0
             arr = np.asarray(st)  # the ONE host sync for the backlog
+            if deviceprofile.enabled():
+                self.profile.record_verdict_reduce(
+                    deviceprofile.now() - rt0)
             out = []
             for b, (statuses, live, cv, ws) in enumerate(per_batch):
                 row = arr[b][: len(live)].tolist()
@@ -548,6 +685,22 @@ class Resolver:
             return out
 
         return ResolveHandle(materialize=materialize)
+
+    def _get_scan_fn(self, use_fast, B):
+        """The cached multi-batch scan for (variant, pad width) — a
+        cache miss is an XLA compilation, recorded (with any later
+        shape-driven retrace through ops/conflict.count_retraces) into
+        the device profile's compile-cache accounting."""
+        key = (use_fast, B)
+        scan_fn = self._scan_fns.get(key)
+        if scan_fn is None:
+            scan_fn = ck.count_retraces(
+                self._make_scan_fn(use_fast),
+                lambda _sig, _k=key: self.profile.record_compile(_k),
+                gate=deviceprofile.enabled,
+            )
+            self._scan_fns[key] = scan_fn
+        return scan_fn
 
     def _dispatch_flat(self, batches):
         """The columnar backlog dispatch: the whole group packs into one
@@ -568,19 +721,43 @@ class Resolver:
             flats, [(cv, ws) for _, cv, ws in batches],
             self.base_version, B=B,
         )
-        key = (use_fast, B)
-        scan_fn = self._scan_fns.get(key)
-        if scan_fn is None:
-            scan_fn = self._make_scan_fn(use_fast)
-            self._scan_fns[key] = scan_fn
+        scan_fn = self._get_scan_fn(use_fast, B)
         import time as _time
 
+        prof = deviceprofile.enabled()
+        if prof:
+            pp = packer.params
+            ent = {
+                "pr": sum(int(f.prc.sum()) for f in flats),
+                "pw": sum(int(f.pwc.sum()) for f in flats),
+                "rr": sum(int(f.rrc.sum()) for f in flats),
+                "rw": sum(int(f.rwc.sum()) for f in flats),
+            }
+            xfer = sum(int(x.nbytes) for x in jax.tree.leaves(stacked))
+            pt0 = deviceprofile.now()
         t0 = _time.perf_counter()
         self.state, st = scan_fn(self.state, stacked)
         self.dispatch_wall_s += _time.perf_counter() - t0
+        if prof:
+            self.profile.record_dispatch(
+                bucket=B, live_batches=len(flats),
+                live_txns=sum(len(f) for f in flats),
+                txn_slots=B * pp.txns,
+                entries_live=ent,
+                entry_slots={"pr": B * pp.txns * pp.point_reads,
+                             "pw": B * pp.txns * pp.point_writes,
+                             "rr": B * pp.txns * pp.range_reads,
+                             "rw": B * pp.txns * pp.range_writes},
+                transfer_bytes=xfer,
+                wall_s=deviceprofile.now() - pt0)
 
         def materialize():
+            self._profile_lanes(st)
+            rt0 = deviceprofile.now() if deviceprofile.enabled() else 0.0
             arr = np.asarray(st)  # the ONE host sync for the backlog
+            if deviceprofile.enabled():
+                self.profile.record_verdict_reduce(
+                    deviceprofile.now() - rt0)
             return [
                 arr[b][: len(f)].tolist() for b, f in enumerate(flats)
             ]

@@ -110,6 +110,9 @@ class ClusterService:
             # workload attribution: hot ranges + per-tag rollup alone
             # (fdbcli `top`, tools/heatmap.py split-point advice)
             "metrics_hot": self.metrics_hot,
+            # device-path execution profile alone (fdbcli `profile`):
+            # resolver dispatch/pad/fallback accounting + lane walls
+            "device_profile": self.device_profile,
             "get_read_version": self.get_read_version,
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
@@ -166,6 +169,9 @@ class ClusterService:
 
     def metrics_hot(self, top=None):
         return self.cluster.hot_ranges_status(top=top)
+
+    def device_profile(self):
+        return self.cluster.device_profile_status()
 
     def get_read_version(self, priority="default", tags=()):
         return self.cluster.grv_proxy.get_read_version(
@@ -659,6 +665,9 @@ class RemoteCluster:
 
     def hot_ranges_status(self, top=None):
         return self._call("metrics_hot", top)
+
+    def device_profile_status(self):
+        return self._call("device_profile")
 
     # management surface (the special key space's commit-time handles)
     def exclude_storage(self, sid):

@@ -27,6 +27,7 @@ from foundationdb_tpu.server.router import StorageRouter
 from foundationdb_tpu.server.sequencer import Sequencer
 from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog, TLogSystem
+from foundationdb_tpu.utils import deviceprofile
 from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils.trace import TraceEvent
@@ -70,6 +71,12 @@ class Cluster:
         # storage recruitment, and configure() shrink (absorbed, never
         # rewound) exactly like the metric registries above.
         self._heatmap_store = {}
+        # Device-path execution profiles (utils/deviceprofile.py), the
+        # third member of the cluster-owned observability store: keyed
+        # ("resolver", index) and re-handed to every resolver
+        # incarnation via adopt_profile, so dispatch/pad/fallback
+        # accounting survives respawn, recovery, and configure shrink.
+        self._device_store = {}
         self.ratekeeper = Ratekeeper(
             target_tps=target_tps if target_tps is not None else 1e9,
             clock=rk_clock,
@@ -159,6 +166,7 @@ class Cluster:
                 Resolver(knobs, base_version=recovered)
                 for _ in range(n_resolvers)
             ]
+        self._attach_device_profiles()
         # Placement: replication defaults to n_storage (every storage a
         # full replica); replication < n_storage partitions the keyspace
         # into shards owned by teams of that size, with the commit proxy
@@ -287,6 +295,34 @@ class Cluster:
     def _role_heatmaps(self, role):
         return [hm for (r, _), hm in sorted(self._heatmap_store.items())
                 if r == role]
+
+    def _role_profile(self, i=0):
+        """The persistent ("resolver", index) device profile — created
+        on first use, reused by every later incarnation of that
+        resolver (the registry/heatmap accessors' exact twin)."""
+        key = ("resolver", i)
+        prof = self._device_store.get(key)
+        if prof is None:
+            prof = self._device_store[key] = deviceprofile.DeviceProfile(
+                "resolver", index=i
+            )
+        return prof
+
+    def _attach_device_profiles(self):
+        """Hand every resolver its cluster-owned DeviceProfile (first
+        boot AND txn-system recovery — the resize branch builds brand-
+        new instances that would otherwise start blank). A shrinking
+        fleet folds the orphaned indices' device history into member 0
+        first: dispatch counters never go backwards."""
+        n = max(1, len(self.resolvers))
+        for (role, i) in list(self._device_store):
+            if i >= n:
+                self._role_profile(0).absorb(
+                    self._device_store.pop((role, i))
+                )
+        for i, r in enumerate(self.resolvers):
+            if hasattr(r, "adopt_profile"):
+                r.adopt_profile(self._role_profile(i))
 
     def _make_commit_proxy(self, resolve_gate=None, log_gate=None, index=0):
         return CommitProxy(
@@ -502,6 +538,9 @@ class Cluster:
             # in place: the (old, quiesced) proxies share this list;
             # the new frontend built below re-derives its ranges
             self.resolvers[:] = new
+        # every incarnation — respawned or rebuilt — readopts its
+        # cluster-owned device profile (shrinks fold orphans first)
+        self._attach_device_profiles()
         # the database lock and tenant mode are cluster state, not proxy
         # state: survive the recovery (ref: both living in the system
         # keyspace)
@@ -1051,6 +1090,20 @@ class Cluster:
             "tags": self._tag_rollup(),
         }
 
+    def device_profile_status(self):
+        """The device-path execution profile document (``device_profile``
+        RPC / \\xff\\xff/metrics/device / cluster.device): per-resolver
+        dispatch accounting — pad/bucket occupancy, compile-cache
+        events, staging reuse, transfer bytes, per-lane walls — plus a
+        cluster aggregate, all from the cluster-owned store so the doc
+        survives recoveries and configure()."""
+        profs = [p for (_, _), p in sorted(self._device_store.items())]
+        return {
+            "enabled": deviceprofile.enabled(),
+            "resolvers": [p.snapshot() for p in profs],
+            "aggregate": deviceprofile.merged_snapshot(profs),
+        }
+
     def _trace_status(self):
         """The trace/span pipeline's own health: per-type suppression
         (satellite of flow/Trace.cpp event suppression) and the tracing
@@ -1132,6 +1185,10 @@ class Cluster:
                     "tags": hot["tags"],
                 },
                 "metrics": self.metrics_status(),
+                # device-path execution profile (utils/deviceprofile.py):
+                # the resolver dispatch layer's pad/bucket/fallback
+                # accounting, cluster-owned like metrics/heatmaps above
+                "device": self.device_profile_status(),
                 # observability plumbing health: process-wide (cumulative
                 # across incarnations, so kept OUT of the deterministic
                 # per-cluster metrics section) — the trace sink's

@@ -1,0 +1,253 @@
+"""Bench-trajectory differ: per-metric deltas across BENCH_r* rounds.
+
+The bench driver captures one ``BENCH_rNN.json`` per round with the
+shape ``{n, cmd, rc, tail, parsed}`` — ``parsed`` is the driver's read
+of the final stdout line (the compact summary or the headline), and
+``tail`` is a BOUNDED capture of the last ~2KB of stdout, which can be
+cut MID-LINE at the front. This tool turns a sequence of such rounds
+into an aligned per-metric trajectory: first/last/delta/% rows, with
+regression flags oriented by each metric's polarity (throughput up is
+good; latency, pad waste, recompiles, lane skew up is bad). It is the
+reader for the device-path profiler fields (pad_waste_pct,
+bucket_histogram totals, recompiles, fallback_causes, lane_skew_pct)
+that bench.py now stamps on every e2e line, and for the schema_rev /
+git_rev provenance header — but it diffs ANY numeric field it finds,
+so older rounds (pre-profiler, pre-provenance) align with explicit
+"n/a" cells rather than KeyErrors.
+
+Usage::
+
+    python -m foundationdb_tpu.tools.benchdiff BENCH_r01.json BENCH_r05.json
+    python -m foundationdb_tpu.tools.benchdiff --json BENCH_r*.json
+"""
+
+import json
+import os
+import sys
+
+NA = "n/a"
+
+# Metric polarity by substring, checked in order (first hit wins):
+# LOWER_BETTER before HIGHER_BETTER so e.g. "conflict_rate" resolves
+# lower-better even though bare "rate" names lean higher-better.
+LOWER_BETTER = (
+    "_ms", "overhead_pct", "conflict_rate", "pad_waste", "lane_skew",
+    "recompiles", "aborted", "fallback_causes", "backlog",
+)
+HIGHER_BETTER = (
+    "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",
+    "repair_rate", "commit_rate", "pipeline_depth", "configs.",
+)
+# relative change below this is measurement noise, not a trend
+REGRESSION_THRESHOLD_PCT = 5.0
+
+
+def polarity(key):
+    """+1 higher-better, -1 lower-better, 0 unknown (never flagged)."""
+    for s in LOWER_BETTER:
+        if s in key:
+            return -1
+    for s in HIGHER_BETTER:
+        if s in key:
+            return +1
+    return 0
+
+
+def _last_json_line(tail):
+    """The last complete JSON-object line of a bounded stdout tail.
+    The capture window can cut the front line mid-object (observed in
+    BENCH_r04: ONE front-cut line), so walk from the end and take the
+    first line that parses to a dict; None when nothing does."""
+    for ln in reversed((tail or "").splitlines()):
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def load_round(path):
+    """One round file → ``{name, n, rc, doc, note}``. ``doc`` is the
+    best available bench line: the driver's ``parsed`` when it is a
+    dict, else the last complete JSON line of ``tail``, else None
+    (``note`` says why). Bare bench-line dicts (no ``tail``/``parsed``
+    wrapper) are accepted as their own doc, so the tool also diffs raw
+    ``bench.py`` output saved by hand."""
+    with open(path) as f:
+        raw = json.load(f)
+    name = os.path.basename(path)
+    if not isinstance(raw, dict):
+        return {"name": name, "n": None, "rc": None, "doc": None,
+                "note": "not a JSON object"}
+    if "parsed" not in raw and "tail" not in raw:
+        return {"name": name, "n": raw.get("n"), "rc": raw.get("rc"),
+                "doc": raw, "note": "bare bench line"}
+    doc = raw.get("parsed") if isinstance(raw.get("parsed"), dict) \
+        else None
+    note = "parsed"
+    if doc is None:
+        doc = _last_json_line(raw.get("tail"))
+        note = "recovered from tail" if doc is not None \
+            else "unparseable (crash or tail cut mid-line)"
+    return {"name": name, "n": raw.get("n"), "rc": raw.get("rc"),
+            "doc": doc, "note": note}
+
+
+def extract_metrics(doc):
+    """Flatten one bench line to ``{key: number}``. Top-level numerics
+    keep their names; ``configs`` entries become ``configs.<name>``
+    (compact-summary scalars directly, folded rich configs via their
+    ``value``); dict-valued fields (bucket_histogram, fallback_causes)
+    contribute their SUM as ``<key>.total`` so the trajectory shows
+    volume drift without a column per bucket."""
+    out = {}
+    if not isinstance(doc, dict):
+        return out
+    for k, v in doc.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = v
+        elif k == "configs" and isinstance(v, dict):
+            for cname, c in v.items():
+                if isinstance(c, bool):
+                    continue
+                if isinstance(c, (int, float)):
+                    out[f"configs.{cname}"] = c
+                elif isinstance(c, dict):
+                    cv = c.get("value")
+                    if isinstance(cv, (int, float)) \
+                            and not isinstance(cv, bool):
+                        out[f"configs.{cname}"] = cv
+        elif isinstance(v, dict):
+            nums = [x for x in v.values()
+                    if isinstance(x, (int, float))
+                    and not isinstance(x, bool)]
+            if nums:
+                out[f"{k}.total"] = sum(nums)
+    return out
+
+
+def diff_rounds(rounds):
+    """Aligned trajectory over loaded rounds → report dict. Every
+    metric seen in ANY round gets a row; rounds where it is absent
+    (older schema, crashed round) show ``"n/a"`` — tolerance to
+    missing fields is the point, not an error path."""
+    per_round = [extract_metrics(r["doc"]) for r in rounds]
+    keys = sorted({k for m in per_round for k in m})
+    rows = []
+    for k in keys:
+        vals = [m.get(k) for m in per_round]
+        present = [(i, v) for i, v in enumerate(vals) if v is not None]
+        row = {
+            "metric": k,
+            "values": [NA if v is None else v for v in vals],
+            "first": NA, "last": NA, "delta": NA, "pct": NA,
+            "trend": NA,
+        }
+        if len(present) >= 2:
+            (_, first), (_, last) = present[0], present[-1]
+            delta = round(last - first, 4)
+            pct = round((last - first) / abs(first) * 100, 2) \
+                if first else None
+            pol = polarity(k)
+            trend = "~"
+            if pct is not None and pol != 0 \
+                    and abs(pct) >= REGRESSION_THRESHOLD_PCT:
+                worse = (pct < 0) if pol > 0 else (pct > 0)
+                trend = "REGRESSION" if worse else "improved"
+            row.update(first=first, last=last, delta=delta,
+                       pct=NA if pct is None else pct, trend=trend)
+        elif len(present) == 1:
+            row.update(first=present[0][1], last=present[0][1])
+        rows.append(row)
+    headers = []
+    for r, m in zip(rounds, per_round):
+        doc = r["doc"] or {}
+        headers.append({
+            "name": r["name"], "n": r["n"], "rc": r["rc"],
+            "note": r["note"],
+            # provenance header (bench.py stamps these since
+            # schema_rev 2); absent in older rounds → explicit n/a
+            "schema_rev": doc.get("schema_rev", NA),
+            "git_rev": doc.get("git_rev", NA),
+            "metric": doc.get("metric", NA),
+            "value": doc.get("value", NA),
+            "n_metrics": len(m),
+        })
+    regressions = [r["metric"] for r in rows if r["trend"] == "REGRESSION"]
+    return {"rounds": headers, "metrics": rows,
+            "regressions": regressions,
+            "schema_revs": sorted({h["schema_rev"] for h in headers},
+                                  key=str)}
+
+
+def format_report(report):
+    """The human-facing text report: one header line per round, then
+    the aligned metric table, regressions summarised last."""
+    lines = []
+    hs = report["rounds"]
+    lines.append(f"bench trajectory: {len(hs)} rounds")
+    for h in hs:
+        lines.append(
+            f"  {h['name']}: rc={h['rc']} schema_rev={h['schema_rev']} "
+            f"git_rev={h['git_rev']} metric={h['metric']} "
+            f"value={h['value']} [{h['note']}]"
+        )
+    if len(report["schema_revs"]) > 1:
+        lines.append(
+            f"  NOTE: mixed schema_revs {report['schema_revs']} — "
+            "renamed fields may align as n/a, not as each other"
+        )
+    w = max((len(r["metric"]) for r in report["metrics"]), default=10)
+    lines.append("")
+    lines.append(
+        f"  {'metric'.ljust(w)}  {'first':>12}  {'last':>12}  "
+        f"{'delta':>12}  {'pct':>8}  trend"
+    )
+    for r in report["metrics"]:
+        lines.append(
+            f"  {r['metric'].ljust(w)}  {str(r['first']):>12}  "
+            f"{str(r['last']):>12}  {str(r['delta']):>12}  "
+            f"{str(r['pct']):>8}  {r['trend']}"
+        )
+    lines.append("")
+    if report["regressions"]:
+        lines.append(
+            f"REGRESSIONS ({len(report['regressions'])}): "
+            + ", ".join(report["regressions"])
+        )
+    else:
+        lines.append("no regressions flagged")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.tools.benchdiff",
+        description="Diff bench metrics across BENCH_r* round files.",
+    )
+    ap.add_argument("files", nargs="+", help="round files, in order")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    rounds = [load_round(p) for p in args.files]
+    report = diff_rounds(rounds)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    # nonzero exit when the trajectory regressed: the same gate shape
+    # as the smoke modes, so CI can chain `bench && benchdiff`
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,7 @@ class Cli:
             "  tenant quota NAME [TPS|clear]   per-tenant rate limit",
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  top [conflict|read|write] [K]   hottest key ranges + tags",
+            "  profile [json]                  device-path dispatch profile",
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
@@ -589,6 +590,49 @@ class Cli:
                      "busyness") if f in row
                 )
                 self._p(f"  {tag}: {fields}")
+
+
+    def _cmd_profile(self, args):
+        """Device-path execution profile (ref: fdbcli's profiler
+        commands over flow/Profiler.actor.cpp): the resolver fleet's
+        dispatch accounting — pad/bucket occupancy, compile events,
+        staging reuse, fallback causes, per-lane walls — read through
+        the ``\\xff\\xff/metrics/device`` special key so the same
+        command works against remote clusters."""
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        doc = json.loads(self._run(lambda tr: tr.get(sk.DEVICE)))
+        if args and args[0] == "json":
+            self._p(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        if not doc.get("enabled", True):
+            self._p("Device profiling is disabled")
+        agg = doc.get("aggregate", {})
+        self._p(
+            "Device profile (aggregate):",
+            f"  dispatches: {agg.get('dispatches', 0)}"
+            f"  recompiles: {agg.get('recompiles', 0)}",
+            f"  pad_waste_pct: {agg.get('pad_waste_pct', 0.0)}"
+            f"  bucket_histogram: {agg.get('bucket_histogram', {})}",
+            f"  staging_reuse_rate: {agg.get('staging_reuse_rate', 0.0)}"
+            f"  transfer_bytes: {agg.get('transfer_bytes', 0)}",
+            f"  dispatch_wall_ms: {agg.get('dispatch_wall_ms', 0.0)}"
+            f"  verdict_reduce_wall_ms: "
+            f"{agg.get('verdict_reduce_wall_ms', 0.0)}",
+            f"  fallback_causes: {agg.get('fallback_causes', {})}",
+        )
+        for r in doc.get("resolvers", ()):
+            lanes = r.get("lanes", 0)
+            lane_note = (
+                f" lanes={lanes} lane_skew_pct={r.get('lane_skew_pct')}"
+                if lanes > 1 else ""
+            )
+            self._p(
+                f"  resolver {r.get('id')}: "
+                f"dispatches={r.get('dispatches')} "
+                f"pad_waste_pct={r.get('pad_waste_pct')} "
+                f"recompiles={r.get('recompiles')}{lane_note}"
+            )
 
 
 def main(argv=None):

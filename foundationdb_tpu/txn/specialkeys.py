@@ -37,6 +37,10 @@ METRICS_JSON = b"\xff\xff/metrics/json"
 # workload attribution (utils/heatmap.py): fleet-merged conflict/read/
 # write hot ranges + per-tag rollup, without the rest of the status doc
 HOT_RANGES = b"\xff\xff/metrics/hot_ranges"
+# device-path execution profile (utils/deviceprofile.py): per-resolver
+# dispatch/pad/fallback accounting + the cluster aggregate, without the
+# rest of the status doc — what `fdbcli profile` polls
+DEVICE = b"\xff\xff/metrics/device"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
@@ -110,6 +114,18 @@ def _hot_ranges_json(tr):
     return json.dumps(doc, sort_keys=True).encode()
 
 
+def _device_json(tr):
+    """The device-path execution profile alone (dispatch accounting,
+    pad/bucket occupancy, fallback causes, lane walls) — what
+    `fdbcli profile` polls."""
+    cluster = tr._cluster
+    if hasattr(cluster, "device_profile_status"):
+        doc = cluster.device_profile_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        doc = tr.db.status().get("cluster", {}).get("device", {})
+    return json.dumps(doc, sort_keys=True).encode()
+
+
 def _tracing_rows(tr):
     """The tracing module's materialized rows (cluster config + this
     transaction's token), RYW-overlaid with pending tracing writes."""
@@ -159,6 +175,8 @@ def get(tr, key):
         return _metrics_json(tr)
     if key == HOT_RANGES:
         return _hot_ranges_json(tr)
+    if key == DEVICE:
+        return _device_json(tr)
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
     if key == DB_LOCKED:
@@ -195,6 +213,8 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         rows.append((METRICS_JSON, get(tr, METRICS_JSON)))
     if begin <= HOT_RANGES < end:
         rows.append((HOT_RANGES, get(tr, HOT_RANGES)))
+    if begin <= DEVICE < end:
+        rows.append((DEVICE, get(tr, DEVICE)))
     if begin <= CONNECTION_STRING < end:
         rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
     rows += [

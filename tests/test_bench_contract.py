@@ -33,6 +33,12 @@ def test_compact_summary_is_small_and_headline_last():
         # workload attribution (ISSUE 8)
         "hot_range_buckets": 192, "hot_range_top_conflict": "user42",
         "tags_seen": 1,
+        # device-path execution profiler (ISSUE 9)
+        "pad_waste_pct": 37.5, "bucket_histogram": {"1": 3, "8": 2},
+        "recompiles": 2, "lane_skew_pct": 12.0,
+        "fallback_causes": {"pallas_to_jit": 0, "flat_to_legacy": 1,
+                            "sharded_to_local": 0, "over_capacity": 0,
+                            "too_old_rv": 0},
         # static-analysis debt (analysis/flowlint.py): 0 must still ride
         "flowlint_findings": 0,
     }
@@ -75,6 +81,14 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["commit_p50_ms"] == 1.1
     assert line["commit_p99_ms"] == 3.2
     assert line["grv_p99_ms"] == 0.4
+    # the device-path profiler gauges ride the summary; the fallback
+    # taxonomy is compressed to the causes that actually fired so the
+    # fixed five-key dict does not bloat the tail
+    assert line["pad_waste_pct"] == 37.5
+    assert line["bucket_histogram"] == {"1": 3, "8": 2}
+    assert line["recompiles"] == 2
+    assert line["lane_skew_pct"] == 12.0
+    assert line["fallback_causes"] == {"flat_to_legacy": 1}
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -142,7 +156,13 @@ def test_e2e_line_folds_proxies_and_platform():
                 "hot_range_buckets", "hot_range_top_conflict",
                 "hot_range_top_read", "hot_range_top_write",
                 "hot_range_conflict_heat", "tags_seen", "tag_busiest",
-                "workload_sampling"):
+                "workload_sampling",
+                # device-path execution profiler (ISSUE 9): every line
+                # carries the dispatch/pad/fallback gauges
+                "pad_waste_pct", "bucket_histogram", "recompiles",
+                "fallback_causes", "lane_skew_pct",
+                "device_dispatches", "staging_reuse_rate",
+                "transfer_bytes"):
         assert key in fields, key
     assert fields["e2e_proxies"] == 2
     # workload sampling is default-ON and the tagged client was counted
@@ -164,6 +184,15 @@ def test_e2e_line_folds_proxies_and_platform():
     # spans were actually recorded (live bands, not placeholder zeros)
     assert fields["commit_p99_ms"] >= fields["commit_p50_ms"] >= 0
     assert fields["commit_p99_ms"] > 0
+    # the device profiler saw the run: dispatches were counted, and the
+    # taxonomy is the full fixed five-cause dict on the e2e line (the
+    # compact summary compresses it, the e2e line never does)
+    assert fields["device_dispatches"] > 0
+    assert set(fields["fallback_causes"]) == {
+        "pallas_to_jit", "flat_to_legacy", "sharded_to_local",
+        "over_capacity", "too_old_rv"}
+    # the cpu backend resolves at live size: no padding, no pad waste
+    assert fields["pad_waste_pct"] == 0.0
 
 
 def test_metrics_smoke_contract():
@@ -210,6 +239,30 @@ def test_heatmap_smoke_contract():
     from foundationdb_tpu.utils import heatmap as heatmap_mod
 
     assert heatmap_mod.enabled()
+
+
+def test_profile_smoke_contract():
+    """BENCH_MODE=profile_smoke: the device-profiler overhead probe
+    emits the budget fields plus the profiler gauges from the enabled
+    arm, and restores the kill switch. One short round checks the
+    contract; the bench run owns the statistically serious
+    comparison."""
+    out = bench.run_profile_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "profile_overhead_pct", "overhead_budget_pct",
+                "within_budget", "pad_waste_pct", "bucket_histogram",
+                "recompiles", "fallback_causes", "lane_skew_pct",
+                "device_dispatches", "staging_reuse_rate",
+                "commit_p50_ms", "commit_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_profile_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the enabled arm really profiled: dispatches flowed end to end
+    assert out["device_dispatches"] > 0
+    # the probe restored the kill switch (profiling stays default-on)
+    from foundationdb_tpu.utils import deviceprofile as dev_mod
+
+    assert dev_mod.enabled()
 
 
 def test_tracing_smoke_contract():

@@ -509,3 +509,63 @@ def test_fl001_allows_key_sample_stream_sampling():
             self._read_heat.charge(key, self._sample_w)
     """)
     assert findings == []
+
+
+# ───────── FL004/FL001: the device-profiler capture sites (ISSUE 9) ─────────
+def test_fl004_flags_profiler_hook_inside_jit_reachable_fn():
+    """The device profiler records HOST-SIDE only: a record_dispatch
+    call (a self-attribute mutation plus host work) inside a
+    jit-reachable kernel body would re-trace or silently no-op under
+    jit — FL004 must trip on the hook, proving the capture sites have
+    to sit around the device call, never inside it."""
+    findings = lint("ops/foo.py", """
+        import jax
+        import numpy as np
+
+        def _kernel(self, state, batch):
+            self.profile.record_dispatch(
+                bucket=1, live_batches=1,
+                live_txns=int(np.sum(batch)), txn_slots=8)
+            return state
+
+        _step = jax.jit(_kernel)
+    """)
+    assert rules_of(findings) == ["FL004"]
+    assert "np.sum" in findings[0].message
+    assert "'_kernel'" in findings[0].message
+
+
+def test_fl004_profiler_hook_around_the_device_call_passes():
+    """The shipped shape: time and record OUTSIDE the jitted fn. The
+    jit root stays pure; the wrapper owns the accounting."""
+    findings = lint("ops/foo.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def _kernel(state, batch):
+            return jnp.maximum(state, batch)
+
+        _step = jax.jit(_kernel)
+
+        def dispatch(self, state, batch):
+            out = _step(state, batch)
+            self.profile.record_dispatch(
+                bucket=1, live_batches=1, live_txns=4, txn_slots=8)
+            return out
+    """)
+    assert findings == []
+
+
+def test_fl001_flags_raw_entropy_in_profiler_sampling():
+    """A profiler that subsampled dispatches via an unseeded draw would
+    make two same-seed sims emit divergent cluster.device docs — the
+    byte-identical determinism contract depends on FL001 tripping
+    here."""
+    findings = lint("utils/deviceprofile.py", """
+        import random
+
+        def record_dispatch(self, bucket, live_txns):
+            if random.random() < 0.1:
+                self.dispatches += 1
+    """)
+    assert rules_of(findings) == ["FL001"]
