@@ -4,7 +4,9 @@ handed off on every exception path.
 Ref rationale: the actor compiler statically guarantees a Promise is
 either fulfilled or broken when its holder dies (flow/flow.h — a
 dropped Promise sends broken_promise to every waiter). Our
-``CommitFuture`` / ``ResolveHandle`` have no such backstop: a future
+``CommitFuture`` / ``ResolveHandle`` — and the async read path's
+``FutureValue`` / ``FutureRange`` (txn/futures.py) — have no such
+backstop: a future
 constructed and then orphaned by an exception leaves a client blocked
 forever, and an unconsumed pipeline group leaves the fleet's
 VersionGates waiting on a turn no one will take. PR 1's contract —
@@ -41,9 +43,12 @@ from foundationdb_tpu.analysis.base import (
 )
 
 RULE = "FL002"
-TITLE = "future-settlement: settle CommitFuture/ResolveHandle on every path"
+TITLE = ("future-settlement: settle CommitFuture/ResolveHandle/"
+         "FutureValue/FutureRange on every path")
 
-ACQ_CONSTRUCTORS = {"CommitFuture", "ResolveHandle"}
+ACQ_CONSTRUCTORS = {
+    "CommitFuture", "ResolveHandle", "FutureValue", "FutureRange",
+}
 ACQ_METHODS = {"commit_batches_begin"}
 SETTLE_ATTRS = {"set", "set_result", "set_exception", "wait", "cancel"}
 SAFE_NAME_CALLS = {

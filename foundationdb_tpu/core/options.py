@@ -85,6 +85,23 @@ class Knobs:
     # recovery (tests shrink it; see server/proxy.py GateTimeout)
     gate_timeout_s: float = 60.0
 
+    # --- read batching (txn/futures.py) ---
+    # client-side multiplexed read batching: outstanding async reads on
+    # one connection coalesce into single read_batch RPCs (ref:
+    # NativeAPI serving every read through futures). max_keys bounds
+    # one flush; window_ms is an optional linger after the first wake
+    # (0 = flush whatever is queued immediately — the measured-best
+    # default: async issue order already coalesces a client window).
+    # Manual/sim pipelines always flush immediately for determinism.
+    read_batch_max_keys: int = 128
+    read_batch_window_ms: float = 0.0
+    # CPython thread-switch interval for server processes
+    # (tools/fdbserver.py): a waiting read-RPC thread is scheduled only
+    # every switch interval, so under commit load the default 5ms adds
+    # whole slices to every synchronous read RTT (measured ~25% of the
+    # loaded read cost at 0.5ms vs 5ms).
+    server_switch_interval_s: float = 0.0005
+
     # --- distributed tracing (utils/span.py) ---
     # fraction of transactions that carry a sampled trace (0 = tracing
     # off; `fdbcli tracing on` / \xff\xff/tracing/enabled turns it to

@@ -37,7 +37,9 @@ from foundationdb_tpu.rpc.transport import (
     RpcServer,
     connect_any,
 )
+from foundationdb_tpu.txn.futures import FutureRange, FutureValue
 from foundationdb_tpu.rpc.wire import PROTOCOL_VERSION
+from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
@@ -117,6 +119,7 @@ class ClusterService:
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
             "get_range": self.get_range,
+            "read_batch": self.read_batch,
             "commit": self.commit,
             "commit_batch": self.commit_batch,
             "watch_register": self.watch_register,
@@ -189,6 +192,51 @@ class ClusterService:
             begin, end, rv, limit=limit, reverse=reverse
         )
         return [(k, v) for k, v in rows]
+
+    def read_batch(self, ops):
+        """One multiplexed read RPC (the client ReadBatcher's flush):
+        N coalesced reads, decoded once, served under ONE storage lock
+        acquisition (StorageServer.read_batch). Slots are per-op —
+        FDBError values ride the wire natively, so one too-old key
+        fails alone, never the batch."""
+        ops = list(ops)
+        sp = span_mod.from_context(
+            "storage.read_batch", span_mod.current(), ops=len(ops)
+        )
+        try:
+            st = self.cluster.read_storage()
+            rb = getattr(st, "read_batch", None)
+            if rb is not None:
+                return rb(ops)
+            # storage tier without a vectorized serve: same slots, one
+            # op at a time (semantics identical, just more crossings)
+            out = []
+            for op in ops:
+                try:
+                    if op[0] == "g":
+                        out.append(
+                            self.cluster.read_storage(op[1]).get(
+                                op[1], op[2]
+                            )
+                        )
+                    elif op[0] == "r":
+                        out.append([
+                            (k, v) for k, v in st.get_range(
+                                op[1], op[2], op[3],
+                                limit=op[4], reverse=op[5],
+                            )
+                        ])
+                    elif op[0] == "s":
+                        out.append(st.resolve_selector(op[1], op[2]))
+                    else:
+                        raise FDBError.from_name(
+                            "client_invalid_operation"
+                        )
+                except FDBError as e:
+                    out.append(e)
+            return out
+        finally:
+            sp.finish()
 
     def commit(self, request):
         # the proxy returns (never raises) FDBError verdicts; the wire
@@ -547,6 +595,28 @@ class _RemoteStorage:
         return self._read("get_range", begin, end, rv, limit, reverse,
                           span=(begin, end))
 
+    # ── async forms: futures settled by the connection's ReadBatcher
+    # (txn/futures.py) — N outstanding reads ride one read_batch RPC ──
+    def get_async(self, key, rv, finalize=None, ctx=None):
+        b = self._rc.read_batcher
+        fut = FutureValue(batcher=b, finalize=finalize)
+        b.submit(("g", key, rv), fut, ctx)
+        return fut
+
+    def get_range_async(self, begin, end, rv, limit=0, reverse=False,
+                        finalize=None, ctx=None):
+        b = self._rc.read_batcher
+        fut = FutureRange(batcher=b, finalize=finalize)
+        b.submit(("r", begin, end, rv, limit, reverse), fut, ctx)
+        return fut
+
+    def resolve_selector_async(self, selector, rv, finalize=None,
+                               ctx=None):
+        b = self._rc.read_batcher
+        fut = FutureValue(batcher=b, finalize=finalize)
+        b.submit(("s", selector, rv), fut, ctx)
+        return fut
+
     def watch(self, key, seen_value):
         wid = self._rc._call("watch_register", key, seen_value)
         return _RemoteWatch(self._rc, wid)
@@ -571,6 +641,7 @@ class RemoteCluster:
         self._workers = []  # RpcClients to storage-worker processes
         self._worker_rr = 0
         self._worker_strikes = {}  # client -> consecutive 1009 lags
+        self._read_batcher = None  # lazy: built on first async read
         self.grv_proxy = _RemoteGrvProxy(self)
         self.commit_proxy = _RemoteCommitProxy(self)
         self.change_feeds = _RemoteChangeFeeds(self)
@@ -653,6 +724,80 @@ class RemoteCluster:
         if self._knobs is None:
             self._knobs = Knobs(**self._call("knobs"))
         return self._knobs
+
+    @property
+    def read_batcher(self):
+        """This connection's read multiplexer (txn/futures.py), built
+        lazily so read-free clients never pay the knobs fetch or the
+        flusher thread. Thread-mode pipelines get the windowed flusher;
+        sync/manual flush synchronously inside submit (deterministic —
+        a sim's RPC sequence is a pure function of its schedule)."""
+        rb = self._read_batcher
+        if rb is not None:
+            return rb
+        kn = self.knobs  # outside _lock: _call reconnects under it
+        from foundationdb_tpu.txn.futures import ReadBatcher
+
+        with self._lock:
+            if self._read_batcher is None:
+                self._read_batcher = ReadBatcher(
+                    self._send_read_batch,
+                    max_keys=kn.read_batch_max_keys,
+                    window_s=kn.read_batch_window_ms / 1e3,
+                    thread=(self.commit_pipeline == "thread"),
+                )
+            return self._read_batcher
+
+    @staticmethod
+    def _batch_span(ops):
+        """Bounding [begin, end) of a batch's ops, or None when any op
+        needs full keyspace coverage (selectors walk) — the coverage
+        key for routing a whole batch at one tag-scoped worker."""
+        lo = hi = None
+        for op in ops:
+            if op[0] == "g":
+                b, e = op[1], op[1] + b"\x00"
+            elif op[0] == "r" and isinstance(op[1], bytes) \
+                    and isinstance(op[2], bytes):
+                b, e = op[1], op[2]
+            else:
+                return None
+            if lo is None or b < lo:
+                lo = b
+            if hi is None or e > hi:
+                hi = e
+        return None if lo is None else (lo, hi)
+
+    def _send_read_batch(self, ops):
+        """One multiplexed read RPC (the ReadBatcher's send): worker
+        round-robin by the batch's bounding span; a lagging worker's
+        per-op 1009 slots are re-served from the lead and the worker
+        strikes (the _RemoteStorage._read policy, batch-shaped)."""
+        from foundationdb_tpu.rpc.transport import RemoteError
+
+        ops = list(ops)
+        worker = self._next_worker(self._batch_span(ops))
+        if worker is not None:
+            try:
+                slots = worker.call("read_batch", ops)
+            except (ConnectionLost, OSError, RemoteError):
+                self._drop_worker(worker)
+            else:
+                lagging = [
+                    i for i, s in enumerate(slots)
+                    if isinstance(s, FDBError) and s.code == 1009
+                ]
+                if not lagging:
+                    self._worker_ok(worker)
+                    return slots
+                self._worker_strike(worker)
+                redo = self._call(
+                    "read_batch", [ops[i] for i in lagging]
+                )
+                for i, slot in zip(lagging, redo):
+                    slots[i] = slot
+                return slots
+        return self._call("read_batch", ops)
 
     def read_storage(self, key=b""):
         return self._storage
@@ -830,6 +975,9 @@ class RemoteCluster:
         return Database(self)
 
     def close(self):
+        rb = self._read_batcher
+        if rb is not None:
+            rb.close()  # settles queued reads retryably (FL002)
         if hasattr(self.commit_proxy, "close"):
             self.commit_proxy.close()  # client-side batcher thread
         with self._lock:

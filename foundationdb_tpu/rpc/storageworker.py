@@ -26,7 +26,7 @@ import itertools
 import threading
 import time
 
-from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.core.keys import key_successor
 from foundationdb_tpu.core.mutations import Mutation, Op
 from foundationdb_tpu.rpc.transport import (
@@ -391,6 +391,49 @@ class StorageWorker:
         self._check_cover(None)  # selectors walk: full coverage only
         return self._wait_version(rv).resolve_selector(selector, rv)
 
+    @staticmethod
+    def _op_span(op):
+        """The coverage span one batched read op needs (None = full
+        keyspace — selector walks and selector-bounded ranges)."""
+        if op[0] == "g":
+            return (op[1], op[1] + b"\x00")
+        if op[0] == "r" and isinstance(op[1], bytes) \
+                and isinstance(op[2], bytes):
+            return (op[1], op[2])
+        return None
+
+    def read_batch(self, ops):
+        """Multiplexed multi-op serve with PER-OP error slots: a
+        mis-routed key (coverage backstop) or a version this worker
+        never catches answers 1009 for ITS slot only — the lead
+        re-serves just those; the rest of the batch lands here. One
+        version wait covers the batch (waits for the max rv), then
+        the local store's vectorized serve runs under one lock."""
+        ops = list(ops)
+        out = [None] * len(ops)
+        todo = []  # [(index, op)] — ops that passed the cover check
+        for i, op in enumerate(ops):
+            try:
+                self._check_cover(self._op_span(op))
+            except FDBError as e:
+                out[i] = e
+                continue
+            todo.append((i, op))
+        if todo:
+            rv = max(
+                op[3] if op[0] == "r" else op[2] for _, op in todo
+            )
+            try:
+                st = self._wait_version(rv)
+            except FDBError as e:
+                for i, _ in todo:
+                    out[i] = e
+            else:
+                slots = st.read_batch([op for _, op in todo])
+                for (i, _), slot in zip(todo, slots):
+                    out[i] = slot
+        return out
+
     def worker_status(self):
         return {
             "name": self.name,
@@ -406,6 +449,7 @@ class StorageWorker:
             "storage_get": self.storage_get,
             "get_range": self.get_range,
             "resolve_selector": self.resolve_selector,
+            "read_batch": self.read_batch,
             "worker_status": self.worker_status,
         }
 
@@ -413,7 +457,8 @@ class StorageWorker:
         """Expose the read surface; registers with the lead."""
         server = RpcServer(
             host, port, self.handlers(),
-            long_methods={"storage_get", "get_range", "resolve_selector"},
+            long_methods={"storage_get", "get_range", "resolve_selector",
+                          "read_batch"},
             secret=self.secret,
         )
         self._advertise = server.address  # tail ticks re-register us

@@ -980,6 +980,20 @@ class Cluster:
         apply_ = metrics_mod.merged_bands_ms(
             [s.metrics.get_latency("storage_apply") for s in self.storages]
         )
+        # multiplexed read serving (txn/futures.py ReadBatcher →
+        # StorageServer.read_batch): serve-latency bands plus the
+        # reads-per-RPC histogram — read_batch_keys records len(ops)/1e3
+        # so its ms-scaled bands read back as raw batch sizes
+        rbatch = metrics_mod.merged_bands_ms(
+            [s.metrics.get_latency("read_batch") for s in self.storages]
+        )
+        rkeys = metrics_mod.merged_bands_ms(
+            [s.metrics.get_latency("read_batch_keys") for s in self.storages]
+        )
+        read_batches = sum(
+            s.metrics.counter("read_batches").value for s in self.storages)
+        batched_reads = sum(
+            s.metrics.counter("batched_reads").value for s in self.storages)
         # hottest-stage attribution: the commit-pipeline stage with the
         # most TOTAL wall time across the fleet is the critical path an
         # operator should look at first
@@ -1002,6 +1016,16 @@ class Cluster:
                 "grv_latency_p99_ms": grv["p99_ms"],
                 "tlog_push_p99_ms": push["p99_ms"],
                 "storage_apply_p99_ms": apply_["p99_ms"],
+                # batched-read observability: serve latency, batch-size
+                # percentiles (reads-per-RPC), and the coalesce rate
+                # (mean reads each batch RPC carried)
+                "read_batch_p99_ms": rbatch["p99_ms"],
+                "read_batch_size_p50": round(rkeys["p50_ms"], 1),
+                "read_batch_size_p99": round(rkeys["p99_ms"], 1),
+                "read_batches": read_batches,
+                "batched_reads": batched_reads,
+                "read_batch_coalesce_rate": round(
+                    batched_reads / max(read_batches, 1), 2),
                 "hottest_stage": hottest,
                 "hottest_stage_totals_s": {
                     k: round(v, 6) for k, v in stage_totals.items()

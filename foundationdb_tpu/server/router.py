@@ -14,7 +14,7 @@ so the transaction layer is placement-agnostic: full replication is
 just the one-shard case.
 """
 
-from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.core.errors import FDBError, err
 from foundationdb_tpu.server.storage import RangeReadInterface
 
 
@@ -58,6 +58,52 @@ class StorageRouter(RangeReadInterface):
     # ── point ops ──
     def get(self, key, version):
         return self.storage_for(key).get(key, version)
+
+    def read_batch(self, ops):
+        """Multiplexed multi-op serve across the tier: point gets
+        group per owning storage (one lock crossing per storage per
+        batch — StorageServer.read_batch), ranges/selectors serve
+        per-op (they may stitch shards). Per-op FDBError slots, never
+        batch-fatal — a dead replica fails only its own keys."""
+        out = [None] * len(ops)
+        groups = {}  # team -> [(index, op)] — ONE replica pick per
+        # team per batch (picking per key would round-robin a team's
+        # replicas and split the batch into singletons)
+        for i, op in enumerate(ops):
+            if op[0] == "g":
+                try:
+                    team = self.map.team_for(op[1])
+                except FDBError as e:
+                    out[i] = e
+                    continue
+                groups.setdefault(tuple(team), []).append((i, op))
+            else:
+                out[i] = self._serve_one(op)
+        for team, members in groups.items():
+            try:
+                st = self._pick(team)
+            except FDBError as e:
+                for i, _ in members:
+                    out[i] = e
+                continue
+            slots = st.read_batch([op for _, op in members])
+            for (i, _), slot in zip(members, slots):
+                out[i] = slot
+        return out
+
+    def _serve_one(self, op):
+        try:
+            if op[0] == "r":
+                return [
+                    (k, v) for k, v in self.get_range(
+                        op[1], op[2], op[3], limit=op[4], reverse=op[5]
+                    )
+                ]
+            if op[0] == "s":
+                return self.resolve_selector(op[1], op[2])
+            raise err("client_invalid_operation")
+        except FDBError as e:
+            return e
 
     def watch(self, key, seen_value):
         """Registered on the key's current owner. A shard relocation

@@ -37,6 +37,10 @@ LOWER_BETTER = (
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",
     "repair_rate", "commit_rate", "pipeline_depth", "configs.",
+    # read multiplexing (ISSUE 11): more reads per RPC and bigger
+    # batch-size percentiles mean better coalescing ("read_batch_p99_ms"
+    # — the serve latency — still resolves lower-better via "_ms" above)
+    "coalesce_rate", "read_batch_p",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

@@ -31,6 +31,7 @@ import signal
 import sys
 import threading
 
+from foundationdb_tpu.core.options import Knobs
 from foundationdb_tpu.rpc.coordination import CoordinatorService, remote_quorum
 from foundationdb_tpu.rpc.service import (
     ClusterService,
@@ -120,6 +121,11 @@ def main(argv=None):
                    help="shared secret for the transport handshake; every "
                         "process and client of the cluster must use the "
                         "same one (defaults to $FDB_TPU_AUTH_SECRET)")
+    p.add_argument("--switch-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="CPython thread switch interval for this server "
+                        "process (default: the server_switch_interval_s "
+                        "knob; 0 keeps the interpreter default)")
     args = p.parse_args(argv)
     secret = args.auth_secret or os.environ.get("FDB_TPU_AUTH_SECRET")
 
@@ -131,7 +137,12 @@ def main(argv=None):
     # 4.2ms at 0.5ms — the residue is GIL convoy on both ends of the
     # synchronous read (see bench.py e2e_multiproc_bottleneck). Commit
     # throughput is unaffected (its hot sections are numpy/C calls).
-    sys.setswitchinterval(0.0005)
+    # Tunable as the server_switch_interval_s knob / --switch-interval.
+    switch_s = args.switch_interval
+    if switch_s is None:
+        switch_s = Knobs().server_switch_interval_s
+    if switch_s > 0:
+        sys.setswitchinterval(switch_s)
 
     host, _, port = args.listen.rpartition(":")
     if secret is None and host not in ("", "127.0.0.1", "localhost",

@@ -1,0 +1,269 @@
+"""Async read futures + the client-side read batcher.
+
+Ref parity: fdbclient/NativeAPI.actor.cpp serves every read through
+futures — ``Transaction::get`` returns ``Future<Optional<Value>>`` and
+the blocking form is ``wait()`` over the same machinery. Here
+:class:`FutureValue` / :class:`FutureRange` are the Python analogs the
+transaction layer returns from ``get_async`` / ``get_range_async``,
+and :class:`ReadBatcher` is the per-connection multiplexer (the read
+analog of the in-repo GRV/commit batchers): N outstanding reads ride
+ONE ``read_batch`` RPC — one wire frame, one server GIL crossing —
+instead of N blocking round trips.
+
+Settlement discipline (FL002): both future classes are registered as
+acquisition constructors in ``analysis/rules/fl002_settlement.py`` —
+a constructed read future must be settled, waited, cancelled, or
+handed off on every path, exactly like a CommitFuture. The batcher's
+``close()`` settles everything still queued with a retryable error
+(``process_behind``), so teardown can never strand a waiter.
+
+Waiting (FL003): waiters park on the batcher's shared completion
+condition (one notify_all per settled batch — the CommitFuture
+lesson: per-future Events were measurable at e2e rates), and the
+flusher thread waits only on the condition wrapping its own lock.
+
+Determinism (FL001): no wall clock and no entropy here. The optional
+batch window sleeps ``time.sleep`` real time in thread mode only;
+immediate mode (manual/sim pipelines) flushes synchronously inside
+``submit`` so two same-seed sims issue identical RPC sequences.
+"""
+
+import threading
+
+from foundationdb_tpu.core.errors import FDBError, err
+from foundationdb_tpu.utils import span as span_mod
+
+_UNSET = object()
+
+
+class FutureValue:
+    """Resolves to one read's value (or raises its FDBError).
+
+    Lifecycle: constructed by an async read, settled by the batcher
+    (``set`` / ``set_exception``), consumed by ``wait()``. An optional
+    ``finalize`` callback runs exactly once on the CONSUMING thread —
+    the transaction layer uses it for per-key bookkeeping (span
+    finish, conflict range, repair op-log) that must happen with the
+    settled value but on the caller, not the flusher thread.
+    ``wait()`` memoizes the finalized value, so repeated waits are
+    free and finalize never runs twice.
+    """
+
+    __slots__ = ("_raw", "_error", "_final", "_finalize", "_batcher")
+
+    def __init__(self, batcher=None, finalize=None):
+        self._raw = _UNSET
+        self._error = None
+        self._final = _UNSET
+        self._finalize = finalize
+        self._batcher = batcher
+
+    def done(self):
+        return self._raw is not _UNSET or self._error is not None
+
+    def _notify(self):
+        b = self._batcher
+        if b is not None:
+            with b._done_cond:
+                b._done_cond.notify_all()
+
+    def set(self, value):
+        """Settle with a value (idempotent: first settlement wins)."""
+        if self.done():
+            return
+        self._raw = value
+        self._notify()
+
+    def set_exception(self, error):
+        if self.done():
+            return
+        self._error = error
+        self._notify()
+
+    def wait(self):
+        """Block until settled, run finalize once, return the value
+        (or raise the per-key FDBError). The sync read forms are
+        exactly ``get_async(...).wait()``."""
+        if self._final is not _UNSET:
+            return self._final
+        if not self.done():
+            b = self._batcher
+            if b is None:
+                raise err("client_invalid_operation")
+            cond = b._done_cond
+            with cond:
+                cond.wait_for(self.done)
+        fin, self._finalize = self._finalize, None
+        e = self._error
+        if e is not None:
+            if fin is not None:
+                fin(None, e)
+            raise e
+        val = self._raw
+        if fin is not None:
+            val = fin(val, None)
+        self._final = val
+        return val
+
+    def cancel(self, error=None):
+        """Settle an unsettled future with a retryable error and run
+        any pending finalize for its cleanup side (swallowing the
+        error) — the teardown path ``Transaction._reset`` uses so an
+        abandoned async read never strands bookkeeping (FL002)."""
+        if not self.done():
+            self.set_exception(
+                error if error is not None else err("transaction_cancelled")
+            )
+        fin, self._finalize = self._finalize, None
+        if fin is not None and self._final is _UNSET:
+            try:
+                if self._error is not None:
+                    fin(None, self._error)
+                elif self._raw is not _UNSET:
+                    # settled with a value but never consumed: run the
+                    # success-path bookkeeping with the real value
+                    self._final = fin(self._raw, None)
+            except FDBError:
+                pass
+
+
+class FutureRange(FutureValue):
+    """A FutureValue resolving to list[(key, value)] — the async
+    ``get_range`` result (distinct type for API parity with the
+    reference's Future<RangeResult>; behavior is inherited)."""
+
+    __slots__ = ()
+
+
+class ReadBatcher:
+    """Per-connection read multiplexer (ref: NativeAPI coalescing
+    outstanding reads toward storage; the read-side analog of
+    ``_CoalescingGrvProxy``): async reads enqueue (op, future) pairs
+    and a flusher drains up to ``max_keys`` of them into one
+    ``send(ops) -> [value-or-FDBError, ...]`` call.
+
+    ``thread=True`` (live deployments): a daemon flusher thread wakes
+    on the first submit, optionally lingers ``window_s``, then sends.
+    ``thread=False`` (manual/sim pipelines): ``submit`` flushes
+    synchronously — deterministic, and still batched when the caller
+    queued several ops before the first ``wait()``.
+
+    Partial failure: a per-op FDBError slot settles ONLY that op's
+    future; a transport-level failure settles the whole batch with a
+    retryable error (the client retry loop owns it from there).
+    """
+
+    def __init__(self, send, max_keys=128, window_s=0.0, thread=True):
+        self._send_fn = send
+        self.max_keys = max(1, int(max_keys))
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._done_cond = threading.Condition()  # shared waiter parking
+        self._queue = []  # [(op, future, span_ctx)]
+        self._closed = False
+        self.batches_sent = 0
+        self.ops_sent = 0
+        self._thread = None
+        if thread:
+            self._thread = threading.Thread(
+                target=self._flusher_loop, name="read-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # ── client surface ──
+    def submit(self, op, fut, ctx=None):
+        """Enqueue one read op for its constructed future (the caller
+        holds the future — FL002 handoff happens at this call)."""
+        with self._lock:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                self._queue.append((op, fut, ctx))
+                self._wake.notify()
+        if closed:
+            fut.set_exception(err("process_behind"))
+            return
+        if self._thread is None:
+            self._flush_now()
+
+    def pending(self):
+        with self._lock:
+            return len(self._queue)
+
+    def _drain(self):
+        with self._lock:
+            batch, self._queue = (
+                self._queue[: self.max_keys],
+                self._queue[self.max_keys:],
+            )
+        return batch
+
+    def _flush_now(self):
+        batch = self._drain()
+        while batch:
+            self._send_batch(batch)
+            batch = self._drain()
+
+    # ── flusher ──
+    def _flusher_loop(self):
+        import time
+
+        while True:
+            with self._wake:
+                while not self._queue and not self._closed:
+                    self._wake.wait()
+                if self._closed:
+                    return  # close() settles what remains queued
+            if self.window_s:
+                time.sleep(self.window_s)  # linger: let a window pile in
+            self._flush_now()
+
+    def _send_batch(self, batch):
+        """One multiplexed RPC for ``batch``; every member future
+        settles here no matter how the send fails (FL002)."""
+        # the batch's span context: the FIRST sampled member's — the
+        # server parents its storage.read_batch span to that trace
+        # (the commit batcher's first_request_context idiom)
+        ctx = None
+        for _, _, c in batch:
+            if c is not None and c[2]:
+                ctx = c
+                break
+        prior = span_mod.set_current(ctx)
+        try:
+            slots = self._send_fn([op for op, _, _ in batch])
+        except FDBError as e:
+            for _, fut, _ in batch:
+                fut.set_exception(e)
+            return
+        except Exception:
+            # transport-level failure: every op retries via the client
+            # loop (the _RemoteStorage path already exhausted reconnect)
+            for _, fut, _ in batch:
+                fut.set_exception(err("process_behind"))
+            return
+        finally:
+            span_mod.set_current(prior)
+        self.batches_sent += 1
+        self.ops_sent += len(batch)
+        for (_, fut, _), slot in zip(batch, slots):
+            if isinstance(slot, FDBError):
+                fut.set_exception(slot)  # per-key: not batch-fatal
+            else:
+                fut.set(slot)
+
+    def close(self):
+        """Settle every queued read with a retryable error and stop
+        the flusher — teardown can never strand a waiter (FL002)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._queue = self._queue, []
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        for _, fut, _ in pending:
+            fut.set_exception(err("process_behind"))

@@ -21,6 +21,7 @@ from foundationdb_tpu.core.mutations import Mutation, Op
 from foundationdb_tpu.core.versions import Versionstamp
 from foundationdb_tpu.server.proxy import CommitRequest
 from foundationdb_tpu.txn import specialkeys
+from foundationdb_tpu.txn.futures import FutureRange, FutureValue
 from foundationdb_tpu.txn.rows import WriteMap
 from foundationdb_tpu.utils import span as span_mod
 
@@ -180,6 +181,15 @@ class Transaction:
         return self.db._cluster
 
     def _reset(self):
+        # settle any still-outstanding async reads FIRST (before their
+        # finalize bookkeeping's targets are replaced below): an
+        # abandoned future is cancelled retryably and its span/op-log
+        # cleanup runs — reset can never strand a waiter (FL002)
+        pending = getattr(self, "_pending_reads", None)
+        if pending:
+            for fut in pending:
+                fut.cancel()
+        self._pending_reads = []  # in-flight FutureValue/FutureRange
         knobs = self.db._knobs
         self._knobs = knobs  # cached: ~3 property hops per op otherwise
         self._read_version = None
@@ -317,60 +327,83 @@ class Transaction:
         if self._state == "cancelled":
             raise err("transaction_cancelled")
 
-    def _traced_read(self, key, rv, snapshot=False):
-        """One storage point read, wrapped in a ``txn.read`` span when
-        this transaction is traced (the span's context rides the read
-        RPC as the wire's tracing frame). A repaired retry serves the
-        read from the verified cache (txn/repair.py) — the cached value
-        is resolver-proven equal to storage at ``rv`` — and the repair
-        engine records every storage-backed non-snapshot read."""
+    @staticmethod
+    def _settled(value=None, error=None, cls=FutureValue, finalize=None):
+        """An already-resolved future (special-space rows, RYW-complete
+        lookups, in-process storage): constructed and settled in one
+        place so every return path hands back the same surface."""
+        fut = cls(finalize=finalize)
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set(value)
+        return fut
+
+    def _read_future(self, key, rv, snapshot, fold_entry=None):
+        """One storage point read as a future. A repaired retry serves
+        it from the verified cache (txn/repair.py) — resolver-proven
+        equal to storage at ``rv`` — and settles immediately. Otherwise
+        the read rides the cluster's async path (the connection's
+        ReadBatcher — rpc/service.py) when it has one, or resolves
+        inline against in-process storage. The finalize callback runs
+        once on the consuming ``wait()``: span finish, repair op-log
+        record, read-conflict range, RYW fold — the same per-key
+        bookkeeping the synchronous path always did."""
+        writes = self._writes if fold_entry is not None else None
         cache = self._repair_cache
         if cache is not None and key in cache:
             val = cache[key]
-        else:
-            sp = self._span
-            if sp is None or not sp.sampled:
-                val = self._cluster.read_storage(key).get(key, rv)
-            else:
-                rsp = sp.child("txn.read")
-                prior = span_mod.set_current(rsp.context())
-                try:
-                    val = self._cluster.read_storage(key).get(key, rv)
-                finally:
-                    span_mod.set_current(prior)
-                    rsp.finish()
-        eng = self._repair
-        if eng is not None and not snapshot and key not in eng.point_reads:
-            eng.point_reads[key] = val
-        return val
+            eng = self._repair
+            if eng is not None and not snapshot \
+                    and key not in eng.point_reads:
+                eng.point_reads[key] = val
+            if not snapshot:
+                self._add_read_conflict(key, key_successor(key))
+            if writes is not None:
+                val = writes.fold(fold_entry, val)
+            return self._settled(val)
+        sp = self._span
+        rsp = ctx = None
+        if sp is not None and sp.sampled:
+            rsp = sp.child("txn.read")
+            ctx = rsp.context()
 
-    def _traced_range(self, st, b, e, rv, limit, reverse, snapshot=False):
-        """One storage range read under a ``txn.read_range`` span, with
-        the same repair-cache service and op-log recording as
-        ``_traced_read`` (keyed by the full call signature)."""
-        sig = (b, e, limit, reverse)
-        rcache = self._repair_range_cache
-        if rcache is not None and sig in rcache:
-            out = list(rcache[sig])
-        else:
-            sp = self._span
-            if sp is None or not sp.sampled:
-                out = st.get_range(b, e, rv, limit=limit, reverse=reverse)
-            else:
-                rsp = sp.child("txn.read_range")
-                prior = span_mod.set_current(rsp.context())
-                try:
-                    out = st.get_range(b, e, rv, limit=limit,
-                                       reverse=reverse)
-                finally:
-                    span_mod.set_current(prior)
-                    rsp.finish()
-        eng = self._repair
-        if eng is not None and not snapshot and sig not in eng.range_reads:
-            eng.range_reads[sig] = tuple(out)
-        return out
+        def finalize(val, error):
+            if rsp is not None:
+                rsp.finish()
+            if error is not None:
+                return None
+            eng = self._repair
+            if eng is not None and not snapshot \
+                    and key not in eng.point_reads:
+                eng.point_reads[key] = val
+            if not snapshot:
+                self._add_read_conflict(key, key_successor(key))
+            return writes.fold(fold_entry, val) \
+                if writes is not None else val
 
-    def get(self, key, snapshot=False):
+        st = self._cluster.read_storage(key)
+        get_async = getattr(st, "get_async", None)
+        if get_async is not None:
+            fut = get_async(key, rv, finalize=finalize, ctx=ctx)
+        else:
+            # in-process storage tier: resolve now, defer bookkeeping
+            # to the consuming wait() exactly like the batched path
+            prior = span_mod.set_current(ctx)
+            try:
+                val, e = st.get(key, rv), None
+            except FDBError as exc:
+                val, e = None, exc
+            finally:
+                span_mod.set_current(prior)
+            fut = self._settled(val, error=e, finalize=finalize)
+        self._pending_reads.append(fut)
+        return fut
+
+    def get_async(self, key, snapshot=False):
+        """Future-returning point read (ref: Transaction::get returns
+        Future<Optional<Value>>); :meth:`get` is ``.wait()`` over the
+        same machinery, so one code path serves both forms."""
         self._guard()
         key = _check_key(key)
         if key.startswith(b"\xff") and specialkeys.contains(key):
@@ -378,23 +411,26 @@ class Transaction:
                 # virtual-module rows aren't verifiable at a later
                 # version: this op log never auto-replays
                 self._repair.unreplayable = True
-            return specialkeys.get(self, key)
+            try:
+                val = specialkeys.get(self, key)
+            except FDBError as e:
+                return self._settled(error=e)
+            return self._settled(val)
         rv = self.get_read_version()
         if not self._ryw_disabled:
             known, needs_base, entry = self._writes.lookup(key)
             if known:
                 if not needs_base:
-                    return self._writes.fold(entry, None)
-                base = self._traced_read(key, rv, snapshot)
-                if not snapshot:
-                    self._add_read_conflict(key, key_successor(key))
-                return self._writes.fold(entry, base)
-        val = self._traced_read(key, rv, snapshot)
-        if not snapshot:
-            self._add_read_conflict(key, key_successor(key))
-        return val
+                    return self._settled(self._writes.fold(entry, None))
+                return self._read_future(key, rv, snapshot,
+                                         fold_entry=entry)
+        return self._read_future(key, rv, snapshot)
 
-    def get_key(self, selector, snapshot=False):
+    def get(self, key, snapshot=False):
+        return self.get_async(key, snapshot=snapshot).wait()
+
+    def get_key_async(self, selector, snapshot=False):
+        """Future-returning key-selector resolution."""
         self._guard()
         if specialkeys.contains(getattr(selector, "key", None)):
             # selector resolution is not defined over the virtual special
@@ -406,17 +442,39 @@ class Transaction:
             # can't be re-verified at the repair version: fall back to
             # the seeded rerun, never the verbatim replay
             self._repair.unreplayable = True
-        k = self._cluster.read_storage().resolve_selector(selector, rv)
-        if not snapshot and k not in (b"", b"\xff"):
-            self._add_read_conflict(k, key_successor(k))
-        return k
 
-    def get_range(self, begin, end, limit=0, reverse=False, snapshot=False,
-                  streaming_mode=None):
-        """Merged range read: snapshot rows overlaid with this txn's writes.
+        def finalize(k, error):
+            if error is not None:
+                return None
+            if not snapshot and k not in (b"", b"\xff"):
+                self._add_read_conflict(k, key_successor(k))
+            return k
 
-        begin/end: bytes or KeySelector. Returns list[(key, value)].
-        """
+        st = self._cluster.read_storage()
+        resolve_async = getattr(st, "resolve_selector_async", None)
+        if resolve_async is not None:
+            fut = resolve_async(selector, rv, finalize=finalize)
+        else:
+            try:
+                k, e = st.resolve_selector(selector, rv), None
+            except FDBError as exc:
+                k, e = None, exc
+            fut = self._settled(k, error=e, finalize=finalize)
+        self._pending_reads.append(fut)
+        return fut
+
+    def get_key(self, selector, snapshot=False):
+        return self.get_key_async(selector, snapshot=snapshot).wait()
+
+    def get_range_async(self, begin, end, limit=0, reverse=False,
+                        snapshot=False, streaming_mode=None):
+        """Future-returning merged range read: snapshot rows overlaid
+        with this txn's writes. begin/end: bytes or KeySelector
+        (selectors resolve synchronously at issue — rare, and a
+        selector walk cannot ride a key-bounded batch). The RYW
+        overlay is captured AT ISSUE TIME, so the result reflects the
+        writes present when the read was issued — the reference's
+        future semantics."""
         self._guard()
         if specialkeys.contains(begin) or (
             isinstance(begin, KeySelector) and specialkeys.contains(begin.key)
@@ -427,10 +485,14 @@ class Transaction:
                 raise err("key_outside_legal_range")
             if self._repair is not None:
                 self._repair.unreplayable = True
-            return specialkeys.get_range(
-                self, begin, min(end, specialkeys.END),
-                limit=limit, reverse=reverse,
-            )
+            try:
+                rows = specialkeys.get_range(
+                    self, begin, min(end, specialkeys.END),
+                    limit=limit, reverse=reverse,
+                )
+            except FDBError as e:
+                return self._settled(error=e, cls=FutureRange)
+            return self._settled(rows, cls=FutureRange)
         rv = self.get_read_version()
         st = self._cluster.read_storage()
         if begin is None:
@@ -446,26 +508,42 @@ class Transaction:
             self._writes.cleared_in(b, e)
             or next(self._writes.overlay_range(b, e), None) is not None
         )
-        if not overlaps:
-            # fast path: no uncommitted writes in range — push limit/reverse
-            # down to storage instead of materializing the whole range
-            out = self._traced_range(st, b, e, rv, limit, reverse, snapshot)
+        if overlaps:
+            # merge path: fetch the whole base range, overlay at wait()
+            # (cleared/overlay snapshots taken NOW — issue-time RYW)
+            cleared = list(self._writes.cleared_in(b, e))
+            overlay = list(self._writes.overlay_range(b, e))
+            req_limit, req_reverse = 0, False
         else:
-            rows = dict(self._traced_range(st, b, e, rv, 0, False, snapshot))
-            for cb, ce in self._writes.cleared_in(b, e):
-                for k in [k for k in rows if cb <= k < ce]:
-                    del rows[k]
-            for k, entry in self._writes.overlay_range(b, e):
-                base = rows.get(k) if not entry.independent else None
-                v = self._writes.fold(entry, base)
+            # fast path: no uncommitted writes in range — push
+            # limit/reverse down to storage instead of materializing
+            cleared = overlay = None
+            req_limit, req_reverse = limit, reverse
+        sig = (b, e, req_limit, req_reverse)
+        writes = self._writes
+
+        def postprocess(rows):
+            if overlay is None:
+                return rows
+            d = dict(rows)
+            for cb, ce in cleared:
+                for k in [k for k in d if cb <= k < ce]:
+                    del d[k]
+            for k, entry in overlay:
+                base = d.get(k) if not entry.independent else None
+                v = writes.fold(entry, base)
                 if v is None:
-                    rows.pop(k, None)
+                    d.pop(k, None)
                 else:
-                    rows[k] = v
-            out = sorted(rows.items(), reverse=reverse)
+                    d[k] = v
+            out = sorted(d.items(), reverse=reverse)
             if limit:
                 out = out[:limit]
-        if not snapshot:
+            return out
+
+        def record_conflict(out):
+            if snapshot:
+                return
             # conflict range covers what was actually observed
             if limit and out:
                 hi = key_successor(out[-1][0]) if not reverse else e
@@ -473,7 +551,70 @@ class Transaction:
                 self._add_read_conflict(lo, hi)
             else:
                 self._add_read_conflict(b, e)
-        return out
+
+        rcache = self._repair_range_cache
+        if rcache is not None and sig in rcache:
+            rows = list(rcache[sig])
+            eng = self._repair
+            if eng is not None and not snapshot \
+                    and sig not in eng.range_reads:
+                eng.range_reads[sig] = tuple(rows)
+            out = postprocess(rows)
+            record_conflict(out)
+            return self._settled(out, cls=FutureRange)
+        sp = self._span
+        rsp = ctx = None
+        if sp is not None and sp.sampled:
+            rsp = sp.child("txn.read_range")
+            ctx = rsp.context()
+
+        def finalize(rows, error):
+            if rsp is not None:
+                rsp.finish()
+            if error is not None:
+                return None
+            eng = self._repair
+            if eng is not None and not snapshot \
+                    and sig not in eng.range_reads:
+                eng.range_reads[sig] = tuple(rows)
+            out = postprocess(rows)
+            record_conflict(out)
+            return out
+
+        range_async = getattr(st, "get_range_async", None)
+        if range_async is not None:
+            fut = range_async(b, e, rv, limit=req_limit,
+                              reverse=req_reverse, finalize=finalize,
+                              ctx=ctx)
+        else:
+            prior = span_mod.set_current(ctx)
+            try:
+                rows, exc = st.get_range(
+                    b, e, rv, limit=req_limit, reverse=req_reverse
+                ), None
+            except FDBError as x:
+                rows, exc = None, x
+            finally:
+                span_mod.set_current(prior)
+            fut = self._settled(rows, error=exc, cls=FutureRange,
+                                finalize=finalize)
+        self._pending_reads.append(fut)
+        return fut
+
+    def get_range(self, begin, end, limit=0, reverse=False, snapshot=False,
+                  streaming_mode=None):
+        """Merged range read: snapshot rows overlaid with this txn's writes.
+
+        begin/end: bytes or KeySelector. Returns list[(key, value)].
+        """
+        return self.get_range_async(
+            begin, end, limit=limit, reverse=reverse, snapshot=snapshot,
+            streaming_mode=streaming_mode,
+        ).wait()
+
+    def get_range_startswith_async(self, prefix, **kw):
+        prefix = bytes(prefix)
+        return self.get_range_async(prefix, strinc(prefix), **kw)
 
     def get_range_startswith(self, prefix, **kw):
         prefix = bytes(prefix)
@@ -666,7 +807,23 @@ class Transaction:
         return handle
 
     # ─────────────────────────── commit ───────────────────────────────
+    def _drain_reads(self):
+        """Settle every still-outstanding async read before the commit
+        request is built: drained reads add their conflict ranges (an
+        unwaited ``get_async`` the app ignored still participates in
+        OCC, matching the reference where the read future's storage
+        reply registered the range regardless of the app consuming
+        it). Per-key read errors stay with their futures — an app that
+        caught (or ignored) a failed read can still commit what it has."""
+        pending, self._pending_reads = self._pending_reads, []
+        for fut in pending:
+            try:
+                fut.wait()
+            except FDBError:
+                pass
+
     def _build_commit_request(self):
+        self._drain_reads()
         # Lazy read version for READ-FREE transactions: with no read
         # conflict ranges the resolver never compares anything against
         # rv — it only places the txn inside the MVCC window — so the
@@ -891,6 +1048,7 @@ class Transaction:
     def commit(self):
         self._guard()
         self._repair_ready = False  # consumed: this IS the resubmission
+        self._drain_reads()
         if not self._mutation_log and not self._write_conflicts:
             # read-only (or management-only): nothing to resolve
             # (ref: read-only commits skip proxies)
@@ -915,6 +1073,7 @@ class Transaction:
         """
         self._guard()
         self._repair_ready = False  # consumed: this IS the resubmission
+        self._drain_reads()
         if not self._mutation_log and not self._write_conflicts:
             from foundationdb_tpu.server.batcher import CommitFuture
 
@@ -987,6 +1146,11 @@ class Transaction:
         """Ref: fdb_transaction_cancel — all further use raises 1025
         until reset()."""
         self._state = "cancelled"
+        # outstanding async reads settle with 1025 NOW (FL002): a
+        # waiter blocked on a cancelled txn's read must not hang
+        pending, self._pending_reads = self._pending_reads, []
+        for fut in pending:
+            fut.cancel()
 
 
 class _WatchHandle:

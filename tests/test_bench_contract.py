@@ -162,8 +162,16 @@ def test_e2e_line_folds_proxies_and_platform():
                 "pad_waste_pct", "bucket_histogram", "recompiles",
                 "fallback_causes", "lane_skew_pct",
                 "device_dispatches", "staging_reuse_rate",
-                "transfer_bytes"):
+                "transfer_bytes",
+                # read multiplexing (ISSUE 11): every line carries the
+                # batch-size percentiles and the coalesce rate
+                "read_batch_p50", "read_batch_p99",
+                "read_batch_coalesce_rate"):
         assert key in fields, key
+    # in-process clusters resolve async reads inline (determinism), so
+    # the batching gauges are exactly zero here — nonzero would mean
+    # the sim-deterministic path started batching
+    assert fields["read_batch_coalesce_rate"] == 0.0
     assert fields["e2e_proxies"] == 2
     # workload sampling is default-ON and the tagged client was counted
     assert fields["workload_sampling"] is True
@@ -321,6 +329,33 @@ def test_repair_smoke_contract():
     # must have attempted repairs (and the counters flowed end to end)
     assert out["repair_attempts"] > 0
     assert out["repair_fallbacks"] > 0
+
+
+def test_read_smoke_contract():
+    """BENCH_MODE=read_smoke: the paired loaded-read-RTT probe (sync
+    blocking get() vs multiplexed get_async windows over a real
+    fdbserver process) emits the RTT/speedup/coalescing fields the
+    trajectory tracks, and the batched arm actually multiplexed. One
+    short round checks the contract; the bench run owns the
+    statistically serious comparison."""
+    out = bench.run_read_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "read_rtt_sync_ms",
+                "read_rtt_batched_ms", "read_speedup", "read_window",
+                "read_ops", "read_batches", "read_batch_coalesce_rate",
+                "read_batch_p50", "read_batch_p99",
+                "read_batch_serve_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_read_smoke"
+    assert out["unit"] == "x"
+    assert out["value"] == out["read_speedup"]
+    # both arms really measured
+    assert out["read_rtt_sync_ms"] > 0
+    assert out["read_rtt_batched_ms"] > 0
+    # the batched arm really multiplexed: fewer RPCs than reads, and
+    # the server saw multi-key batches
+    assert out["read_ops"] > out["read_batches"] > 0
+    assert out["read_batch_coalesce_rate"] > 1.0
+    assert out["read_batch_p99"] > 1.0
 
 
 def test_pack_smoke_contract():
