@@ -1433,6 +1433,8 @@ def _e2e_line(cpu, metric, vs_of=BASELINE_TXNS_PER_SEC,
     line = {
         "metric": metric, "value": value, "unit": "txns/sec",
         "vs_baseline": round(value / vs_of, 3), **fields,
+        "flowlint_by_rule": _flowlint_by_rule(),
+        "lockdep_cycles": _lockdep_cycles(),
     }
     _emit(line)
     return line
@@ -1583,6 +1585,39 @@ def _flowlint_findings():
         return flowlint.count_findings()
     except Exception as e:
         sys.stderr.write(f"flowlint count failed: {type(e).__name__}: {e}\n")
+        return None
+
+
+_FLOWLINT_BY_RULE = [None]  # one lint pass per process, not per config
+
+
+def _flowlint_by_rule():
+    """Per-rule split of the flowlint gauge ({} on a clean tree) so a
+    lint regression in the artifact names its rule without a rerun.
+    Cached: the e2e config lines all reuse one pass."""
+    if _FLOWLINT_BY_RULE[0] is None:
+        try:
+            from foundationdb_tpu.analysis import flowlint
+
+            _FLOWLINT_BY_RULE[0] = flowlint.count_findings_by_rule()
+        except Exception as e:
+            sys.stderr.write(
+                f"flowlint by-rule count failed: {type(e).__name__}: {e}\n")
+            _FLOWLINT_BY_RULE[0] = {}
+    return _FLOWLINT_BY_RULE[0]
+
+
+def _lockdep_cycles():
+    """Lock-order cycles the runtime lockdep witness has observed in
+    THIS process (utils/lockdep.py) — 0 both on a clean tree and when
+    the witness is off; the lockdep_smoke config runs with it ON, so a
+    real runtime inversion surfaces there as a nonzero gauge."""
+    try:
+        from foundationdb_tpu.utils import lockdep
+
+        return lockdep.cycle_count()
+    except Exception as e:
+        sys.stderr.write(f"lockdep count failed: {type(e).__name__}: {e}\n")
         return None
 
 
@@ -1857,6 +1892,70 @@ def run_profile_smoke(cpu, seconds=None, rounds=None):
         "staging_reuse_rate": fields_on.get("staging_reuse_rate"),
         "commit_p50_ms": fields_on.get("commit_p50_ms"),
         "commit_p99_ms": fields_on.get("commit_p99_ms"),
+    }
+
+
+def run_lockdep_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=lockdep_smoke: the runtime lockdep witness's overhead
+    budget, measured — the ycsb e2e with the witness ON (every cluster
+    lock wrapped, per-thread acquisition-order recording, edge/cycle
+    bookkeeping until the graph freezes) vs OFF (factories hand out
+    plain threading primitives), interleaved pairs, median throughput
+    each, ≤2% budget (the metrics_smoke protocol). The witness wraps
+    locks at CONSTRUCTION, so each enabled arm flips it on before
+    run_e2e builds its cluster and off right after. The enabled arm's
+    witness gauges ride along — observed edges prove the witness was
+    live under the measured load, and cycles must be 0 (the same
+    contract FL006 enforces statically)."""
+    from foundationdb_tpu.utils import lockdep
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    backend = "native"
+    runs = {True: [], False: []}
+    edges = cycles = acquisitions = 0
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                lockdep.reset()
+                if on:
+                    lockdep.enable()
+                else:
+                    lockdep.disable()
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    edges = len(lockdep.edge_set())
+                    cycles = lockdep.cycle_count()
+                    acquisitions = lockdep.acquisition_count()
+    finally:
+        lockdep.disable()
+        lockdep.reset()
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_lockdep_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "lockdep_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "lockdep_edges": edges,
+        "lockdep_cycles": cycles,
+        "lockdep_acquisitions": acquisitions,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
     }
 
 
@@ -2215,7 +2314,7 @@ def _compact_summary(out, configs):
               "hot_range_buckets", "hot_range_top_conflict", "tags_seen",
               "pad_waste_pct", "bucket_histogram", "recompiles",
               "fallback_causes", "lane_skew_pct",
-              "flowlint_findings",
+              "flowlint_findings", "flowlint_by_rule", "lockdep_cycles",
               "tpu_recovered", "fallback_from", "error"):
         if out.get(k) is not None:
             line[k] = out[k]
@@ -2260,6 +2359,8 @@ def main():
     # on vs off, ≤2% budget) |
     # profile_smoke (device-path execution profiler overhead: the
     # deviceprofile kill switch on vs off, ≤2% budget) |
+    # lockdep_smoke (runtime lock-order witness overhead: instrumented
+    # vs plain lock factories, ≤2% budget, 0 observed cycles) |
     # read_smoke (loaded read RTT: sync blocking get() vs get_async
     # windows multiplexed into read_batch RPCs, over a real fdbserver
     # process — the ≥3x ISSUE-11 acceptance probe) |
@@ -2359,6 +2460,16 @@ def main():
         _emit(out)
         # same contract as metrics_smoke: the ≤2% budget is a GATE
         if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "lockdep_smoke":
+        out = run_lockdep_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # ≤2% budget gate, plus the correctness half: a runtime
+        # lock-order cycle under the measured load fails the smoke
+        if not out["within_budget"] or out["lockdep_cycles"]:
             sys.exit(1)
         return
 
@@ -2503,7 +2614,9 @@ def main():
             err_out = {"metric": "resolved_txns_per_sec_ycsb_a_zipfian99",
                        "value": 0, "unit": "txns/sec", "vs_baseline": 0.0,
                        "error": f"{type(e).__name__}: {e}"[:300],
-                       "flowlint_findings": _flowlint_findings()}
+                       "flowlint_findings": _flowlint_findings(),
+                       "flowlint_by_rule": _flowlint_by_rule(),
+                       "lockdep_cycles": _lockdep_cycles()}
             _emit(_compact_summary(err_out, configs))
             sys.exit(1)
 
@@ -2587,6 +2700,8 @@ def main():
             sys.stderr.write(f"e2e bench failed: {type(e).__name__}: {e}\n")
             out["e2e_error"] = f"{type(e).__name__}: {e}"[:200]
     out["flowlint_findings"] = _flowlint_findings()
+    out["flowlint_by_rule"] = _flowlint_by_rule()
+    out["lockdep_cycles"] = _lockdep_cycles()
     out["configs"] = configs
     watchdog_finish()
     # the rich headline (full detail, for humans reading the log) …

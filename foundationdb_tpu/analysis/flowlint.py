@@ -5,6 +5,7 @@ Run over the package tree::
     python -m foundationdb_tpu.analysis.flowlint            # whole package
     python -m foundationdb_tpu.analysis.flowlint path/ file.py
     python -m foundationdb_tpu.analysis.flowlint --fix-baseline
+    python -m foundationdb_tpu.analysis.flowlint --fix-lockorder
 
 Exit code 0 = no findings beyond the checked-in baseline
 (``analysis/baseline.txt``); 1 = new findings (printed). The baseline
@@ -19,26 +20,37 @@ Per-line suppression: a ``# flowlint: disable=FL003`` comment on the
 finding's line (or the line above) suppresses that rule there — for
 sites where the pattern is deliberate and the reason is stated inline.
 ``# flowlint: disable-file=FL004`` anywhere in a file suppresses the
-rule for the whole file.
+rule for the whole file. A line suppression that no longer matches any
+finding is itself a finding (``FLSUP``) — dead suppressions rot into
+blanket permission slips, so they fail the run exactly like a stale
+baseline entry records unclaimed progress.
+
+v2 (single-parse engine): every run builds one
+:class:`~foundationdb_tpu.analysis.model.ProgramModel` — each file is
+parsed and tokenized exactly once, shared by all rules — and rules
+come in two shapes: per-file (``check(tree, relpath)``) and
+program-wide (``PROGRAM = True`` + ``check_model(model)``, for the
+cross-module rules FL006/FL007/FL008). Per-rule wall time is reported
+in ``--json`` (``rule_wall_ms``) so tier-1 lint cost stays observable
+as rules grow.
 """
 
 import argparse
-import ast
 import json
 import os
-import re
 import sys
+import time
 from collections import Counter
 
 from foundationdb_tpu.analysis.base import Finding
+from foundationdb_tpu.analysis.model import build_model, parse_rule_list
 from foundationdb_tpu.analysis.rules import ALL_RULES, BY_ID
 
 PKG_NAME = "foundationdb_tpu"
 
-_SUPPRESS_RE = re.compile(r"#\s*flowlint:\s*disable=([A-Z0-9,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(
-    r"#\s*flowlint:\s*disable-file=([A-Z0-9,\s]+)"
-)
+# engine-emitted pseudo-rules (not in ALL_RULES): FL000 = syntax
+# error, FLSUP = stale suppression comment
+SUPPRESSION_RULE = "FLSUP"
 
 
 def package_dir():
@@ -49,6 +61,10 @@ def package_dir():
 
 def default_baseline_path():
     return os.path.join(package_dir(), "analysis", "baseline.txt")
+
+
+def default_lockorder_path():
+    return os.path.join(package_dir(), "analysis", "lockorder.txt")
 
 
 def module_relpath(path, root):
@@ -64,38 +80,113 @@ def module_relpath(path, root):
 
 
 def _parse_rule_list(text):
-    return {r.strip() for r in text.replace(",", " ").split() if r.strip()}
+    return parse_rule_list(text)
+
+
+def _load_test_texts(package_root):
+    """Raw text of tests/*.py next to the package — FL008's
+    version-gate test references grep these; None when the package is
+    installed without its test tree (the checks that need it skip)."""
+    if not package_root:
+        return None
+    tests_dir = os.path.join(os.path.dirname(package_root), "tests")
+    if not os.path.isdir(tests_dir):
+        return None
+    texts = {}
+    for fn in sorted(os.listdir(tests_dir)):
+        if fn.endswith(".py"):
+            try:
+                with open(os.path.join(tests_dir, fn),
+                          encoding="utf-8") as f:
+                    texts[fn] = f.read()
+            except OSError:
+                continue
+    return texts
+
+
+def build_tree_model(items, abspaths=None):
+    """ProgramModel for a scanned file set. ``full_tree`` (the tree
+    contracts: lockorder.txt comparison, dead-knob sweep, test
+    references) turns on only when the scan covers the real package —
+    both anchor files present — so subset and fixture lints stay
+    purely structural."""
+    relpaths = {rp for rp, _ in items}
+    full = "rpc/wire.py" in relpaths and "core/options.py" in relpaths
+    package_root = None
+    test_texts = None
+    if full and abspaths:
+        anchor = abspaths.get("rpc/wire.py")
+        if anchor:
+            package_root = os.path.dirname(os.path.dirname(anchor))
+        test_texts = _load_test_texts(package_root)
+    return build_model(items, full_tree=full, package_root=package_root,
+                       test_texts=test_texts)
+
+
+def lint_model(model, rules=None, timings=None):
+    """All non-suppressed findings for a built model, plus FLSUP
+    findings for stale line suppressions. ``timings`` (optional dict)
+    accumulates per-rule wall seconds."""
+    rules = ALL_RULES if rules is None else rules
+    findings = []
+    used = set()  # (relpath, comment_line, rule) suppressions that hit
+    for fm in model.files.values():
+        if fm.syntax_error is not None:
+            e = fm.syntax_error
+            findings.append(Finding("FL000", fm.relpath, e.lineno or 0,
+                                    f"syntax error: {e.msg}"))
+    for rule in rules:
+        t0 = time.perf_counter()
+        raw = []
+        if getattr(rule, "PROGRAM", False):
+            raw = list(rule.check_model(model))
+        else:
+            for fm in model.files.values():
+                if fm.tree is None or not rule.applies(fm.relpath) or \
+                        rule.RULE in fm.file_disabled:
+                    continue
+                raw.extend(rule.check(fm.tree, fm.relpath))
+        for f in raw:
+            fm = model.files.get(f.path)
+            if fm is not None:
+                if f.rule in fm.file_disabled:
+                    continue
+                dl = fm.line_disabled
+                hit = None
+                if f.rule in dl.get(f.line, ()):
+                    hit = f.line
+                elif f.rule in dl.get(f.line - 1, ()):
+                    hit = f.line - 1
+                if hit is not None:
+                    used.add((f.path, hit, f.rule))
+                    continue
+            findings.append(f)
+        if timings is not None:
+            timings[rule.RULE] = timings.get(rule.RULE, 0.0) + \
+                (time.perf_counter() - t0)
+    # stale suppressions: a disable= comment whose rule RAN but
+    # filtered nothing is dead weight — fail until it's removed
+    ran = {r.RULE for r in rules}
+    for fm in model.files.values():
+        if fm.tree is None:
+            continue
+        for line in sorted(fm.line_disabled):
+            for rid in sorted(fm.line_disabled[line]):
+                if rid not in ran or rid in fm.file_disabled:
+                    continue
+                if (fm.relpath, line, rid) not in used:
+                    findings.append(Finding(
+                        SUPPRESSION_RULE, fm.relpath, line,
+                        f"stale suppression: disable={rid} no longer "
+                        f"matches any finding here — remove it"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
 
 
 def lint_source(relpath, text, rules=None):
     """All non-suppressed findings for one file's source text."""
-    rules = ALL_RULES if rules is None else rules
-    try:
-        tree = ast.parse(text)
-    except SyntaxError as e:
-        return [Finding("FL000", relpath, e.lineno or 0,
-                        f"syntax error: {e.msg}")]
-    file_disabled = set()
-    line_disabled = {}
-    for i, line in enumerate(text.splitlines(), 1):
-        m = _SUPPRESS_FILE_RE.search(line)
-        if m:
-            file_disabled |= _parse_rule_list(m.group(1))
-            continue
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            line_disabled[i] = _parse_rule_list(m.group(1))
-    findings = []
-    for rule in rules:
-        if rule.RULE in file_disabled or not rule.applies(relpath):
-            continue
-        for f in rule.check(tree, relpath):
-            if f.rule in line_disabled.get(f.line, ()) or \
-                    f.rule in line_disabled.get(f.line - 1, ()):
-                continue
-            findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    model = build_tree_model([(relpath, text)])
+    return lint_model(model, rules)
 
 
 def iter_py_files(paths):
@@ -114,17 +205,23 @@ def iter_py_files(paths):
                     yield os.path.join(dirpath, fn)
 
 
-def lint_paths(paths, rules=None):
-    findings = []
+def _read_items(paths):
+    items, abspaths = [], {}
     for path in iter_py_files(paths):
         root = paths[0] if os.path.isdir(paths[0]) else \
             os.path.dirname(paths[0]) or "."
         with open(path, encoding="utf-8") as f:
             text = f.read()
-        findings.extend(
-            lint_source(module_relpath(path, root), text, rules)
-        )
-    return findings
+        rp = module_relpath(path, root)
+        items.append((rp, text))
+        abspaths[rp] = os.path.abspath(path)
+    return items, abspaths
+
+
+def lint_paths(paths, rules=None, timings=None):
+    items, abspaths = _read_items(paths)
+    model = build_tree_model(items, abspaths)
+    return lint_model(model, rules, timings)
 
 
 # ───────────────────────────── baseline ─────────────────────────────
@@ -152,9 +249,10 @@ def format_baseline(findings):
         "#   RULE<TAB>path<TAB>message\n"
         "# Regenerate: python -m foundationdb_tpu.analysis.flowlint "
         "--fix-baseline\n"
-        "# Policy: FL001/FL002/FL003/FL005 must stay EMPTY here (fix "
-        "or suppress inline with a reason); FL004 entries are lint "
-        "debt to burn down.\n"
+        "# Policy: FL001/FL002/FL003/FL005/FL006/FL007/FL008 must stay "
+        "EMPTY here (fix, sanction in lockorder.txt, or suppress "
+        "inline with a reason); FL004 entries are lint debt to burn "
+        "down.\n"
     )
     body = "".join(
         key + "\n" for key in sorted(baseline_key(f) for f in findings)
@@ -189,6 +287,14 @@ def count_findings(paths=None):
     return len(findings)
 
 
+def count_findings_by_rule(paths=None):
+    """Per-rule split of :func:`count_findings` — the bench summary
+    carries it as ``flowlint_by_rule`` so a regression names its rule
+    without a rerun."""
+    findings = lint_paths(paths or [package_dir()])
+    return dict(sorted(Counter(f.rule for f in findings).items()))
+
+
 # ─────────────────────────────── CLI ────────────────────────────────
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -196,7 +302,8 @@ def main(argv=None):
         description="AST invariant checker for foundationdb_tpu "
                     "(FL001 determinism, FL002 future settlement, "
                     "FL003 lock discipline, FL004 jit purity, "
-                    "FL005 exception hygiene).",
+                    "FL005 exception hygiene, FL006 lock order, "
+                    "FL007 thread escape, FL008 protocol/knob drift).",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the installed "
@@ -209,6 +316,10 @@ def main(argv=None):
     ap.add_argument("--fix-baseline", action="store_true",
                     help="rewrite the baseline from the current tree "
                          "and exit 0")
+    ap.add_argument("--fix-lockorder", action="store_true",
+                    help="regenerate analysis/lockorder.txt from the "
+                         "current tree's lock-acquisition graph "
+                         "(sanctioned '<>' pairs are preserved)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--rules", default=None,
@@ -226,7 +337,17 @@ def main(argv=None):
         rules = [BY_ID[r] for r in sorted(wanted)]
     baseline_path = args.baseline or default_baseline_path()
 
-    findings = lint_paths(paths, rules)
+    if args.fix_lockorder:
+        from foundationdb_tpu.analysis.rules import fl006_lockorder
+
+        items, abspaths = _read_items(paths)
+        model = build_tree_model(items, abspaths)
+        path = fl006_lockorder.rewrite_lockorder(model)
+        print(f"lockorder rewritten: {path}")
+        return 0
+
+    timings = {}
+    findings = lint_paths(paths, rules, timings)
 
     if args.fix_baseline:
         with open(baseline_path, "w", encoding="utf-8") as f:
@@ -239,12 +360,15 @@ def main(argv=None):
         load_baseline(baseline_path)
     new, old, stale = split_by_baseline(findings, baseline)
 
+    rule_wall_ms = {r: round(s * 1000.0, 2)
+                    for r, s in sorted(timings.items())}
     if args.json:
         print(json.dumps({
             "new": [f._asdict() for f in new],
             "baselined": len(old),
             "stale_baseline": len(stale),
             "total": len(findings),
+            "rule_wall_ms": rule_wall_ms,
         }, indent=2))
     else:
         for f in new:
@@ -253,9 +377,10 @@ def main(argv=None):
         summary = ", ".join(
             f"{r}={n}" for r, n in sorted(per_rule.items())
         ) or "none"
+        wall = sum(timings.values()) * 1000.0
         print(f"flowlint: {len(new)} new finding(s), {len(old)} "
               f"baselined, {len(stale)} stale baseline entr(ies); "
-              f"totals: {summary}")
+              f"totals: {summary}; rules {wall:.0f}ms")
         if stale:
             print("stale baseline entries (fixed in the tree — run "
                   "--fix-baseline to record the progress):")

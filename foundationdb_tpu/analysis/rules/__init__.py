@@ -1,4 +1,10 @@
-"""flowlint rule registry — one module per rule id."""
+"""flowlint rule registry — one module per rule id.
+
+FL001–FL005 are per-file rules (``check(tree, relpath)``);
+FL006–FL008 are program-wide (``PROGRAM = True`` +
+``check_model(model)``) and read the shared
+:class:`~foundationdb_tpu.analysis.model.ProgramModel`.
+"""
 
 from foundationdb_tpu.analysis.rules import (
     fl001_determinism,
@@ -6,6 +12,9 @@ from foundationdb_tpu.analysis.rules import (
     fl003_locks,
     fl004_jit,
     fl005_exceptions,
+    fl006_lockorder,
+    fl007_threadescape,
+    fl008_protocol,
 )
 
 ALL_RULES = [
@@ -14,6 +23,9 @@ ALL_RULES = [
     fl003_locks,
     fl004_jit,
     fl005_exceptions,
+    fl006_lockorder,
+    fl007_threadescape,
+    fl008_protocol,
 ]
 
 BY_ID = {rule.RULE: rule for rule in ALL_RULES}

@@ -37,13 +37,14 @@ vulnerability, and the sim never exercises the real transport.
 import random
 import threading
 import time
+from foundationdb_tpu.utils import lockdep
 
 
 class DeterminismRegistry:
     """Named RNG streams + an injectable clock, one per process."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("DeterminismRegistry._lock")
         self._streams = {}
         self._seed = None  # None = production mode (OS entropy)
         self._clock = time.time

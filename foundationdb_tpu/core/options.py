@@ -58,7 +58,8 @@ class Knobs:
     txn_repair_max_rounds: int = 4
 
     # --- versions / MVCC ---
-    versions_per_second: int = 1_000_000
+    # (the version rate itself is core.versions.VERSIONS_PER_SECOND —
+    # a protocol constant, not a tunable)
     max_read_transaction_life_versions: int = 5_000_000
 
     # --- transaction limits (ref: fdbclient/Knobs.h CLIENT_KNOBS) ---
@@ -133,6 +134,11 @@ class Knobs:
     storage_sample_every: int = 16
 
     # --- simulation ---
+    # process-global BUGGIFY default (sim/buggify.py): `buggify` arms
+    # the module-level BUGGIFY singleton at import (Simulation always
+    # builds its own seeded instance regardless); `buggify_prob` is the
+    # default per-evaluation fire probability for sites that do not
+    # pass an explicit fire_p.
     buggify: bool = False
     buggify_prob: float = 0.05
 

@@ -18,11 +18,12 @@ import threading
 import numpy as np
 
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD
+from foundationdb_tpu.utils import lockdep
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "conflict_set.cpp")
 _SO = os.path.join(_HERE, "libconflictset.so")
-_lock = threading.Lock()
+_lock = lockdep.lock("native._lock")
 _lib = None
 
 

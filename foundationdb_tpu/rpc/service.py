@@ -27,6 +27,7 @@ import itertools
 import os
 import string
 import threading
+
 import time
 
 from foundationdb_tpu.core import deterministic
@@ -39,6 +40,7 @@ from foundationdb_tpu.rpc.transport import (
 )
 from foundationdb_tpu.txn.futures import FutureRange, FutureValue
 from foundationdb_tpu.rpc.wire import PROTOCOL_VERSION
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
@@ -89,7 +91,7 @@ class ClusterService:
         self.cluster = cluster
         self._watches = {}  # watch_id -> (Watch, threading.Event, born)
         self._watch_ids = itertools.count(1)
-        self._watch_lock = threading.Lock()
+        self._watch_lock = lockdep.lock("ClusterService._watch_lock")
         # The plain synchronous CommitProxy (commit_pipeline="sync") has
         # no internal synchronization — the in-process deployments that
         # use it are single-threaded. Concurrent RPC clients are not:
@@ -99,7 +101,7 @@ class ClusterService:
         if getattr(cluster, "commit_pipeline", "sync") == "thread":
             self._commit_lock = None
         else:
-            self._commit_lock = threading.Lock()
+            self._commit_lock = lockdep.lock("ClusterService._commit_lock")
 
     def handlers(self):
         return {
@@ -457,7 +459,7 @@ class _CoalescingGrvProxy:
 
     def __init__(self, rc):
         self._rc = rc
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("_CoalescingGrvProxy._cond")
         self._started = 0  # GRV rounds begun
         self._done = 0  # GRV rounds completed
         self._last = None  # value of the newest completed round
@@ -634,7 +636,7 @@ class RemoteCluster:
         self.addresses = list(addresses)
         self._connect_timeout = connect_timeout
         self._secret = secret
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("RemoteCluster._lock")
         self._client = None
         self._closed = False
         self._knobs = None

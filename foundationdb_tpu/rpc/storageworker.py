@@ -24,6 +24,7 @@ Shape here:
 
 import itertools
 import threading
+
 import time
 
 from foundationdb_tpu.core.errors import FDBError, err
@@ -35,6 +36,7 @@ from foundationdb_tpu.rpc.transport import (
     RpcClient,
     RpcServer,
 )
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils.trace import TraceEvent
 
 SYSTEM_END = b"\xff\xff"
@@ -59,7 +61,7 @@ class LogFeed:
     def __init__(self, cluster):
         self.cluster = cluster
         self._holds = {}  # name -> last refresh monotonic
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("LogFeed._lock")
 
     def handlers(self):
         return {
@@ -201,7 +203,7 @@ class StorageWorker:
         self._caught_up = threading.Event()
         self._thread = None
         self._client = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("StorageWorker._lock")
         self._advertise = None  # our serve() address, re-registered on tick
         self._last_refresh = 0.0
 

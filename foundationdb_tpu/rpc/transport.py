@@ -26,10 +26,12 @@ import os
 import socket
 import struct
 import threading
+
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from foundationdb_tpu.core.errors import FDBError
 from foundationdb_tpu.rpc import wire
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
 
@@ -109,7 +111,7 @@ class RpcServer:
             else None
         )
         self._conns = set()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("RpcServer._lock")
         self._closed = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rpc-accept", daemon=True
@@ -182,7 +184,7 @@ class RpcServer:
         _send_frame(sock, send_lock, b"\x00ok")
 
     def _serve_conn(self, sock, peer):
-        send_lock = threading.Lock()
+        send_lock = lockdep.lock("RpcServer._serve_conn.send_lock")
         try:
             if self.secret is not None:
                 self._authenticate(sock, send_lock, peer)
@@ -292,7 +294,7 @@ class RpcClient:
         self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = lockdep.lock("RpcClient._send_lock")
         if secret is not None:
             # the server's first frame is the auth nonce; answer before
             # the reader thread starts interpreting frames as replies
@@ -316,7 +318,7 @@ class RpcClient:
                     f"auth handshake with {host}:{port} failed — secret "
                     f"mismatch or server not configured for auth: {e!r}"
                 ) from e
-        self._state_lock = threading.Lock()
+        self._state_lock = lockdep.lock("RpcClient._state_lock")
         self._pending = {}  # seq -> Future
         self._seq = 0
         self._closed = False
@@ -402,6 +404,10 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+        # the shutdown above unblocks the reader's recv; join so close()
+        # returns with no thread still touching the dead socket
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=5)
 
 
 def connect_any(addresses, connect_timeout=5.0, secret=None):

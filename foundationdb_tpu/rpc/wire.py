@@ -31,6 +31,20 @@ from foundationdb_tpu.core.mutations import Mutation, Op
 #     can attribute commits/aborts/conflicts per tag
 PROTOCOL_VERSION = 7
 
+# Every optional trailing frame the protocol has grown, by the version
+# that introduced it. flowlint FL008 walks this table: each name must
+# be mentioned in BOTH _enc and _dec (a decode-only frame is a frame
+# nobody sends; an encode-only frame is unreadable skew) and carry a
+# version-gate test reference under tests/. Growing the protocol means
+# adding the row here FIRST — the lint then fails until both arms and
+# a test exist.
+OPTIONAL_FRAMES = {
+    "flat_conflicts": 4,
+    "span_context": 5,
+    "conflict_version": 6,
+    "tags": 7,
+}
+
 _OPS = list(Op)
 _OP_INDEX = {op: i for i, op in enumerate(_OPS)}
 

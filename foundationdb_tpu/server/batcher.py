@@ -37,6 +37,7 @@ import time
 from collections import deque
 
 from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 from foundationdb_tpu.utils.trace import SEV_ERROR, StageStats, TraceEvent
@@ -99,16 +100,18 @@ class BatchingCommitProxy:
         )
         self.flush_after = flush_after  # manual mode: sim steps before flush
         self.mode = mode
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("BatchingCommitProxy._lock")
         self._pending = []  # [(request, future)]
         self._first_pending_step = None
-        self._wake = threading.Condition(self._lock)
-        self._done_cond = threading.Condition()  # batch-completion waiters
+        self._wake = lockdep.condition("BatchingCommitProxy._lock", self._lock)
+        self._done_cond = lockdep.condition("BatchingCommitProxy._done_cond")  # batch-completion waiters
         self._closed = False
         self.batches_committed = 0
         self.txns_batched = 0
         self.max_batch_seen = 0
+        # flowlint: shared(last-writer-wins debug breadcrumb; readers only poll it)
         self.last_batch_error = None
+        # flowlint: shared(AIMD heuristic target; GIL-atomic int, staleness is benign)
         self._backlog_target = self.MAX_BACKLOG
         self._thread = None
         # ── bounded commit pipeline (thread mode only) ──
@@ -136,7 +139,7 @@ class BatchingCommitProxy:
         self._m_settled_batches = self.metrics.counter("batches_settled")
         self.stages = StageStats(registry=self.metrics)
         self._inflight = deque()  # [(chunks, _PipelinedGroup)] FIFO
-        self._inflight_cv = threading.Condition()
+        self._inflight_cv = lockdep.condition("BatchingCommitProxy._inflight_cv")
         self._occ_level = 0
         self._occ_t = time.perf_counter()
         self._occ_busy = 0.0  # seconds with >=1 group in flight
@@ -525,13 +528,16 @@ class BatchingCommitProxy:
         self._adapt_backlog(txns, conflicts)
 
     def _settle(self, chunk, results):
-        self.batches_committed += 1
-        self.txns_batched += len(chunk)
-        self.max_batch_seen = max(self.max_batch_seen, len(chunk))
         self._record_span(chunk)
         for (_, fut), res in zip(chunk, results):
             fut.set(res)
         with self._done_cond:  # ONE wakeup for the whole batch
+            # stat counters live under _done_cond: _settle runs on the
+            # batcher thread, the apply worker, AND caller threads
+            # (manual/sim pipelines), so the bare += was a lost-update
+            self.batches_committed += 1
+            self.txns_batched += len(chunk)
+            self.max_batch_seen = max(self.max_batch_seen, len(chunk))
             self._done_cond.notify_all()
 
     def _record_span(self, chunk):

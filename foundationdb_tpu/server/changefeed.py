@@ -17,10 +17,12 @@ old read version is — it left the retained window.
 """
 
 import threading
+
 from collections import deque
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.core.mutations import Op
+from foundationdb_tpu.utils import lockdep
 
 
 class _Feed:
@@ -41,7 +43,7 @@ class ChangeFeedRegistry:
     def __init__(self, retention=10_000):
         self.retention = retention
         self._feeds = {}
-        self._mu = threading.Lock()
+        self._mu = lockdep.lock("ChangeFeedRegistry._mu")
 
     def __len__(self):
         return len(self._feeds)

@@ -29,6 +29,7 @@ from foundationdb_tpu.server.storage import StorageServer
 from foundationdb_tpu.server.tlog import TLog, TLogSystem
 from foundationdb_tpu.utils import deviceprofile
 from foundationdb_tpu.utils import heatmap as heatmap_mod
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
@@ -234,7 +235,7 @@ class Cluster:
         # RPC worker thread while the failure monitor ticks on the main
         # thread — two concurrent _recover_txn_system calls would race
         # the generation CAS and tear the frontend swap
-        self._recovery_mu = threading.Lock()
+        self._recovery_mu = lockdep.lock("Cluster._recovery_mu")
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()

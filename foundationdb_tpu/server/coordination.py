@@ -25,6 +25,8 @@ import json
 import os
 import threading
 
+from foundationdb_tpu.utils import lockdep
+
 
 class CoordinatorDown(Exception):
     pass
@@ -52,7 +54,7 @@ class Coordinator:
     """
 
     def __init__(self, path=None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Coordinator._lock")
         self.path = path
         self.alive = True
         self.promised = 0  # highest ballot promised (Paxos phase 1)

@@ -14,9 +14,11 @@ rejected (retryable), bounding client latency.
 """
 
 import threading
+
 import time
 
 from foundationdb_tpu.core.errors import err
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -97,8 +99,8 @@ class BatchingGrvProxy:
         self.inner = inner
         self.interval_s = interval_s
         self.max_wait_s = max_wait_s
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        self._lock = lockdep.lock("BatchingGrvProxy._lock")
+        self._wake = lockdep.condition("BatchingGrvProxy._lock", self._lock)
         # two queues so a starved batch-priority request cannot head-of-
         # line-block default traffic (ref: per-priority GRV queues)
         self._queues = {"default": [], "batch": []}

@@ -21,6 +21,7 @@ from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
 from foundationdb_tpu.utils import heatmap as heatmap_mod
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -46,7 +47,7 @@ class VersionGate:
     def __init__(self, start, timeout=60.0):
         self._v = start
         self.timeout = timeout
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("VersionGate._cond")
 
     def enter(self, prev, timeout=None):
         with self._cond:
@@ -158,7 +159,7 @@ class CommitProxy:
         # commit_batch for lock-aware sub-batches. Uncontended cost is
         # noise; deterministic sims are single-threaded so ordering is
         # unchanged. (Ref: the proxy's commit path is one actor.)
-        self._commit_mu = threading.RLock()
+        self._commit_mu = lockdep.rlock("CommitProxy._commit_mu")
         self._batches_since_pump = 0
         self.pump_interval = 64  # batches between flush + ratekeeper rounds
         self.resolver_bounds = None  # n-1 split keys; None = static split

@@ -17,8 +17,10 @@ same two-loop shape:
 """
 
 import threading
+
 import time
 
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 
 
@@ -65,7 +67,7 @@ class Ratekeeper:
         # thread-mode clusters admit from many client threads while the
         # batcher thread feeds observe_commit/update: the token bucket's
         # read-modify-write must not interleave
-        self._mu = threading.Lock()
+        self._mu = lockdep.lock("Ratekeeper._mu")
         # throttle gauges for the status document (ref: the qos section
         # Ratekeeper feeds in Status.actor.cpp); values are set from the
         # live fields at snapshot time, so admission pays nothing

@@ -12,9 +12,11 @@ from different proxies form one global serial order with no gaps.
 """
 
 import threading
+
 import time
 
 from foundationdb_tpu.core.versions import VERSIONS_PER_SECOND
+from foundationdb_tpu.utils import lockdep
 
 
 class SequencerDown(Exception):
@@ -33,7 +35,7 @@ class Sequencer:
         self._start = start_version
         # concurrent commit proxies request versions from their own
         # threads; grants must be atomic or two batches could share one
-        self._mu = threading.Lock()
+        self._mu = lockdep.lock("Sequencer._mu")
 
     def kill(self):
         """Master death (ref: master failure forcing a full recovery —

@@ -13,6 +13,7 @@ tlog.
 """
 
 import threading
+
 from collections import deque
 
 try:
@@ -25,6 +26,7 @@ from foundationdb_tpu.core.keys import KeySelector, key_successor
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Op, apply_atomic
 from foundationdb_tpu.server.kvstore import KeyValueStoreMemory
 from foundationdb_tpu.utils import heatmap as heatmap_mod
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -136,7 +138,7 @@ class StorageServer(RangeReadInterface):
         # SortedDict iteration is not safe under concurrent mutation, so
         # readers hold the same lock (RLock: flush iterates internally).
         # Single-threaded deployments pay one uncontended acquire per op.
-        self._mu = threading.RLock()
+        self._mu = lockdep.rlock("StorageServer._mu")
         self.alive = True  # failure detection flips this (sim kill)
         self.engine = engine if engine is not None else KeyValueStoreMemory()
         # Versioned engines (the Redwood role, kvstore.KeyValueStoreVersioned)

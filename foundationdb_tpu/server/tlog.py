@@ -26,8 +26,10 @@ import os
 import pickle
 import struct
 import threading
+
 import zlib
 
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -49,10 +51,10 @@ class TLog:
         self._pop_holds = {}  # name -> version: keep records > version
         # holds mutate on RPC handler threads (remote storage workers)
         # while the commit pipeline's pop iterates them — lock the dict
-        self._holds_mu = threading.Lock()
+        self._holds_mu = lockdep.lock("TLog._holds_mu")
         # long-polling peekers (rpc/storageworker.py LogFeed) park here
         # instead of sleep-polling last_version
-        self._data_cond = threading.Condition()
+        self._data_cond = lockdep.condition("TLog._data_cond")
         # push-latency bands + volume counters for the status document
         # (ref: TLogMetrics in TLogServer.actor.cpp). Durations come off
         # the injected clock, so sim snapshots replay deterministically.
@@ -256,7 +258,7 @@ class TLogSystem:
         for i, log in enumerate(self.logs):
             log.index = i  # replica id on each push span
         self._pop_holds = {}
-        self._data_cond = threading.Condition()
+        self._data_cond = lockdep.condition("TLogSystem._data_cond")
 
     @staticmethod
     def replica_paths(wal_path, n):

@@ -11,12 +11,15 @@ happen to be enabled).
 
 import random
 
+from foundationdb_tpu.core.options import DEFAULT_KNOBS
+
 
 class Buggify:
-    def __init__(self, seed=0, enabled=True, site_activated_p=0.25, fire_p=0.05):
+    def __init__(self, seed=0, enabled=True, site_activated_p=0.25, fire_p=None):
         self.enabled = enabled
         self.site_activated_p = site_activated_p
-        self.fire_p = fire_p
+        # default fire probability is the buggify_prob knob
+        self.fire_p = DEFAULT_KNOBS.buggify_prob if fire_p is None else fire_p
         self._seed = seed
         self._sites = {}  # site name -> activated?
         self._rng = random.Random(seed ^ 0xB0661F1)
@@ -39,4 +42,5 @@ class Buggify:
         return sorted(s for s, a in self._sites.items() if a)
 
 
-BUGGIFY = Buggify(enabled=False)  # process-global default: off outside sim
+# process-global default: off outside sim unless the buggify knob arms it
+BUGGIFY = Buggify(enabled=DEFAULT_KNOBS.buggify)

@@ -33,6 +33,10 @@ NA = "n/a"
 LOWER_BETTER = (
     "_ms", "overhead_pct", "conflict_rate", "pad_waste", "lane_skew",
     "recompiles", "aborted", "fallback_causes", "backlog",
+    # static-analysis debt + runtime lock-order witness: any growth is
+    # a regression ("lockdep_overhead_pct" already resolves via
+    # "overhead_pct" above; "flowlint" also covers flowlint_by_rule.*)
+    "flowlint", "lockdep_cycles",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",

@@ -31,6 +31,7 @@ immediate mode (manual/sim pipelines) flushes synchronously inside
 import threading
 
 from foundationdb_tpu.core.errors import FDBError, err
+from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import span as span_mod
 
 _UNSET = object()
@@ -157,9 +158,9 @@ class ReadBatcher:
         self._send_fn = send
         self.max_keys = max(1, int(max_keys))
         self.window_s = float(window_s)
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
-        self._done_cond = threading.Condition()  # shared waiter parking
+        self._lock = lockdep.lock("ReadBatcher._lock")
+        self._wake = lockdep.condition("ReadBatcher._lock", self._lock)
+        self._done_cond = lockdep.condition("ReadBatcher._done_cond")  # shared waiter parking
         self._queue = []  # [(op, future, span_ctx)]
         self._closed = False
         self.batches_sent = 0
@@ -246,8 +247,9 @@ class ReadBatcher:
             return
         finally:
             span_mod.set_current(prior)
-        self.batches_sent += 1
-        self.ops_sent += len(batch)
+        with self._lock:  # stats shared with submit()-side readers
+            self.batches_sent += 1
+            self.ops_sent += len(batch)
         for (_, fut, _), slot in zip(batch, slots):
             if isinstance(slot, FDBError):
                 fut.set_exception(slot)  # per-key: not batch-fatal

@@ -28,16 +28,16 @@ from foundationdb_tpu.utils import span as span_mod
 _INVALID = object()
 
 
-def _check_key(key):
+def _check_key(key, limit=MAX_KEY_SIZE):
     key = bytes(key)
-    if len(key) > MAX_KEY_SIZE:
+    if len(key) > limit:
         raise err("key_too_large")
     return key
 
 
-def _check_value(value):
+def _check_value(value, limit=MAX_VALUE_SIZE):
     value = bytes(value)
-    if len(value) > MAX_VALUE_SIZE:
+    if len(value) > limit:
         raise err("value_too_large")
     return value
 
@@ -655,7 +655,10 @@ class Transaction:
         # _add_write_conflict, key_successor) are inlined — at tens of
         # thousands of commits/sec their call overhead was measurable
         self._guard()
-        key, value = _check_key(key), _check_value(value)
+        # limits come from the knobs (defaults mirror core.keys
+        # constants) so key_size_limit / value_size_limit are tunable
+        key = _check_key(key, self._knobs.key_size_limit)
+        value = _check_value(value, self._knobs.value_size_limit)
         if key.startswith(b"\xff") and specialkeys.contains(key):
             specialkeys.write(self, key, value)
             return

@@ -34,6 +34,7 @@ gates at ≤2% overhead, the metrics_smoke protocol.
 import threading
 
 from foundationdb_tpu.core import deterministic
+from foundationdb_tpu.utils import lockdep
 
 _enabled = True
 
@@ -76,7 +77,7 @@ class DeviceProfile:
     def __init__(self, name, index=0):
         self.name = name
         self.index = index
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("DeviceProfile._lock")
         # dispatch accounting
         self.dispatches = 0
         self.batches_live = 0

@@ -24,6 +24,7 @@ import struct
 import threading
 
 from foundationdb_tpu.core import deterministic
+from foundationdb_tpu.utils import lockdep
 
 _enabled = True
 
@@ -73,7 +74,7 @@ class KeyRangeHeatmap:
         self._k = max(2, int(max_buckets))
         self._hl = float(half_life_s)
         self._decode = decode if decode is not None else (lambda k: k)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("KeyRangeHeatmap._lock")
         self._w = {}  # anchor bytes -> weight at stamp
         self._t = {}  # anchor bytes -> decay stamp
         self._charges = 0  # exact lifetime event count (never decays)

@@ -26,6 +26,7 @@ asserts the enabled run stays within 2% of the disabled one.
 import threading
 
 from foundationdb_tpu.core import deterministic
+from foundationdb_tpu.utils import lockdep
 
 _enabled = True
 
@@ -114,7 +115,7 @@ class LatencySample:
         self._total = 0.0
         self._max = 0.0
         self._rng = deterministic.rng("metrics-reservoir")
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("LatencySample._lock")
 
     def record(self, seconds):
         if not _enabled:
@@ -205,7 +206,7 @@ class MetricsRegistry:
     def __init__(self, role, index=0):
         self.role = role
         self.index = index
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MetricsRegistry._lock")
         self._counters = {}
         self._gauges = {}
         self._latencies = {}

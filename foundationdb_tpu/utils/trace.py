@@ -13,6 +13,8 @@ import json
 import os
 import threading
 import time
+
+from foundationdb_tpu.utils import lockdep
 from collections import deque
 
 SEV_DEBUG = 5
@@ -44,7 +46,7 @@ class TraceLog:
     def __init__(self, path=None, min_severity=SEV_INFO, clock=time.time,
                  max_file_bytes=None, roll_count=None, type_budget=None,
                  suppression_interval_s=None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("TraceLog._lock")
         self._path = path
         self._file = None
         self._file_bytes = 0
@@ -212,7 +214,7 @@ class StageStats:
     cost (and which stage is critical-path) lands in the artifact."""
 
     def __init__(self, registry=None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("StageStats._lock")
         self._total_s = {}
         self._count = {}
         # optional metrics registry: every add() also records into a

@@ -39,8 +39,11 @@ def test_compact_summary_is_small_and_headline_last():
         "fallback_causes": {"pallas_to_jit": 0, "flat_to_legacy": 1,
                             "sharded_to_local": 0, "over_capacity": 0,
                             "too_old_rv": 0},
-        # static-analysis debt (analysis/flowlint.py): 0 must still ride
+        # static-analysis debt (analysis/flowlint.py): 0 must still ride,
+        # split per rule, next to the runtime lock-order witness gauge
         "flowlint_findings": 0,
+        "flowlint_by_rule": {},
+        "lockdep_cycles": 0,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -69,8 +72,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["pack_path"] == "flat"
     assert line["pack_bytes"] == 6052
     assert line["pack_reuse_rate"] == 0.99
-    # lint debt rides the summary — and a clean tree's 0 is not dropped
+    # lint debt rides the summary — and a clean tree's 0 is not dropped;
+    # the per-rule split and the runtime witness gauge ride next to it
     assert line["flowlint_findings"] == 0
+    assert line["flowlint_by_rule"] == {}
+    assert line["lockdep_cycles"] == 0
     # workload attribution rides the summary: bucket bound + hottest
     # conflict range + tag count are tracked numbers per run
     assert line["hot_range_buckets"] == 192
@@ -115,6 +121,15 @@ def test_flowlint_findings_gauge_matches_the_tree():
     installed package) and the shipped tree is clean."""
     n = bench._flowlint_findings()
     assert n == 0, f"shipped tree carries {n} flowlint finding(s)"
+
+
+def test_flowlint_by_rule_and_lockdep_gauges_are_clean():
+    """The per-rule split is empty on a clean tree (the program rules
+    FL006–FL008 included), and the runtime lockdep witness has observed
+    no lock-order cycle in this process."""
+    by_rule = bench._flowlint_by_rule()
+    assert by_rule == {}, f"per-rule lint debt: {by_rule}"
+    assert bench._lockdep_cycles() == 0
 
 
 def test_device_env_restores_original_platform(monkeypatch):
@@ -271,6 +286,31 @@ def test_profile_smoke_contract():
     from foundationdb_tpu.utils import deviceprofile as dev_mod
 
     assert dev_mod.enabled()
+
+
+def test_lockdep_smoke_contract():
+    """BENCH_MODE=lockdep_smoke: the runtime lock-order witness
+    overhead probe emits the budget fields plus the witness gauges
+    from the enabled arm, and restores the disabled default. One short
+    round checks the contract; the bench run owns the statistically
+    serious comparison."""
+    out = bench.run_lockdep_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "lockdep_overhead_pct", "overhead_budget_pct",
+                "within_budget", "lockdep_edges", "lockdep_cycles",
+                "lockdep_acquisitions"):
+        assert key in out, key
+    assert out["metric"] == "e2e_lockdep_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the enabled arm really witnessed the run: the cluster's wrapped
+    # locks nested at least once, and no ordering inverted
+    assert out["lockdep_edges"] > 0
+    assert out["lockdep_cycles"] == 0
+    # the probe restored the default (witness off, plain primitives)
+    from foundationdb_tpu.utils import lockdep
+
+    assert not lockdep.enabled()
+    assert lockdep.edge_set() == frozenset()
 
 
 def test_tracing_smoke_contract():

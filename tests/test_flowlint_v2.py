@@ -1,0 +1,640 @@
+"""flowlint v2 (whole-program rules) + the runtime lockdep witness.
+
+Fixture tests for FL006 (lock-order graph), FL007 (thread escape),
+FL008 (protocol/knob drift) and the FLSUP stale-suppression check,
+plus the dynamic half: utils/lockdep.py must detect cycles at runtime,
+emit byte-identical same-seed witness documents, and only ever observe
+acquisition-order edges the static FL006 graph already predicts.
+"""
+
+import ast
+import json
+import os
+import random
+import sys
+import textwrap
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from foundationdb_tpu.analysis import flowlint  # noqa: E402
+from foundationdb_tpu.analysis.rules import (  # noqa: E402
+    fl006_lockorder,
+    fl007_threadescape,
+    fl008_protocol,
+)
+from foundationdb_tpu.utils import lockdep  # noqa: E402
+
+
+def lint(path, src, rules):
+    return flowlint.lint_source(path, textwrap.dedent(src), rules=rules)
+
+
+def lint_tree(items, rules):
+    model = flowlint.build_tree_model(
+        [(rp, textwrap.dedent(src)) for rp, src in items])
+    return flowlint.lint_model(model, rules)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ───────────────────────────── FL006 ─────────────────────────────
+def test_fl006_flags_abba_cycle():
+    """The canonical ABBA deadlock: two methods nesting the same two
+    locks in opposite orders must produce a lock-order cycle finding."""
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, rules=[fl006_lockorder])
+    assert rules_of(findings) == ["FL006"]
+    assert "cycle" in findings[0].message
+    assert "Pipeline._a" in findings[0].message
+    assert "Pipeline._b" in findings[0].message
+
+
+def test_fl006_condition_sharing_the_mutex_is_one_node():
+    """``threading.Condition(self._lock)`` aliases the wrapped lock:
+    nesting the condition inside its own mutex (wait_for under the
+    lock) is reentrancy on ONE node, not an edge — no cycle, no
+    undeclared order."""
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._other = threading.Lock()
+
+            def put(self):
+                with self._lock:
+                    self._cv.notify_all()
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait()
+                with self._lock:
+                    with self._other:
+                        pass
+
+            def drain(self):
+                with self._cv:
+                    with self._other:
+                        pass
+    """, rules=[fl006_lockorder])
+    # take() and drain() acquire _other under the SAME node — a
+    # consistent order, so the structural pass is silent
+    assert findings == []
+
+
+def test_fl006_abba_across_methods_via_calls():
+    """Inter-procedural: holding A while calling a method whose entry
+    acquires B, while another path holds B and calls into A."""
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Split:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def _grab_a(self):
+                with self._a:
+                    pass
+
+            def forward(self):
+                with self._a:
+                    self._grab_b()
+
+            def backward(self):
+                with self._b:
+                    self._grab_a()
+    """, rules=[fl006_lockorder])
+    assert rules_of(findings) == ["FL006"]
+    assert "cycle" in findings[0].message
+
+
+def test_fl006_tree_lockorder_is_declared_and_live():
+    """The checked-in lockorder.txt matches the tree: every computed
+    edge declared, no stale entries (the full-tree gate already runs in
+    test_flowlint_tree.py; this pins the file's shape)."""
+    with open(flowlint.default_lockorder_path(), encoding="utf-8") as f:
+        text = f.read()
+    declared, pairs = fl006_lockorder.load_lockorder(text)
+    assert declared, "lockorder.txt declares no edges"
+    for (a, b) in declared:
+        assert "." in a and "." in b, f"malformed lock id in {a} -> {b}"
+
+
+# ───────────────────────────── FL007 ─────────────────────────────
+def test_fl007_flags_unlocked_write_from_two_threads():
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.counter = 0
+
+            def start(self):
+                threading.Thread(target=self._run_a, name="a",
+                                 daemon=True).start()
+                threading.Thread(target=self._run_b, name="b",
+                                 daemon=True).start()
+
+            def _run_a(self):
+                self.counter = 1
+
+            def _run_b(self):
+                self.counter = 2
+    """, rules=[fl007_threadescape])
+    assert "FL007" in rules_of(findings)
+    assert any("counter" in f.message for f in findings)
+
+
+def test_fl007_common_lock_on_every_write_site_passes():
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.counter = 0
+
+            def start(self):
+                threading.Thread(target=self._run_a, name="a",
+                                 daemon=True).start()
+                threading.Thread(target=self._run_b, name="b",
+                                 daemon=True).start()
+
+            def _run_a(self):
+                with self._mu:
+                    self.counter = 1
+
+            def _run_b(self):
+                with self._mu:
+                    self.counter = 2
+    """, rules=[fl007_threadescape])
+    assert findings == []
+
+
+def test_fl007_condition_and_its_mutex_are_the_same_protection():
+    """One thread writes under ``with self._cv``, the other under
+    ``with self._lock`` — the condition wraps the lock, so both sites
+    hold the same mutex and the attribute is protected."""
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.state = 0
+
+            def start(self):
+                threading.Thread(target=self._run, name="w",
+                                 daemon=True).start()
+
+            def _run(self):
+                with self._cv:
+                    self.state = 1
+                    self._cv.notify_all()
+
+            def poke(self):
+                with self._lock:
+                    self.state = 2
+    """, rules=[fl007_threadescape])
+    assert findings == []
+
+
+def test_fl007_single_thread_confined_state_needs_nothing():
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._progress = 0
+
+            def start(self):
+                threading.Thread(target=self._run, name="w",
+                                 daemon=True).start()
+
+            def _run(self):
+                self._progress = 1
+                self._step()
+
+            def _step(self):
+                self._progress += 1
+    """, rules=[fl007_threadescape])
+    assert findings == []
+
+
+def test_fl007_shared_annotation_suppresses_with_reason():
+    findings = lint("server/foo.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                # monotonic flag: torn reads impossible on a bool
+                self.done = False  # flowlint: shared(monotonic flag)
+
+            def start(self):
+                threading.Thread(target=self._run, name="w",
+                                 daemon=True).start()
+
+            def _run(self):
+                self.done = True
+
+            def finish(self):
+                self.done = True
+    """, rules=[fl007_threadescape])
+    assert findings == []
+
+
+# ───────────────────────────── FL008 ─────────────────────────────
+def test_fl008_decode_only_frame_is_flagged():
+    """A hypothetical v8 frame wired into _dec but never into _enc:
+    peers would never send what the decoder expects."""
+    findings = lint("rpc/mywire.py", """
+        OPTIONAL_FRAMES = {"span_context": 5, "priority_hint": 8}
+
+        def _enc(req, version):
+            frames = [b"base"]
+            if version >= 5:
+                frames.append(req.span_context)
+            return frames
+
+        def _dec(frames, version):
+            out = {}
+            if version >= 5:
+                out["span_context"] = frames[1]
+            if version >= 8:
+                out["priority_hint"] = frames[2]
+            return out
+    """, rules=[fl008_protocol])
+    assert rules_of(findings) == ["FL008"]
+    assert "priority_hint" in findings[0].message
+    assert "encode" in findings[0].message
+
+
+def test_fl008_encode_only_frame_is_flagged():
+    findings = lint("rpc/mywire.py", """
+        OPTIONAL_FRAMES = {"priority_hint": 8}
+
+        def _enc(req, version):
+            if version >= 8:
+                return [req.priority_hint]
+            return []
+
+        def _dec(frames, version):
+            return {}
+    """, rules=[fl008_protocol])
+    assert rules_of(findings) == ["FL008"]
+    assert "decode" in findings[0].message
+
+
+def test_fl008_paired_arms_pass_on_fixture_scan():
+    findings = lint("rpc/mywire.py", """
+        OPTIONAL_FRAMES = {"priority_hint": 8}
+
+        def _enc(req, version):
+            if version >= 8:
+                return [req.priority_hint]
+            return []
+
+        def _dec(frames, version):
+            if version >= 8:
+                return {"priority_hint": frames[0]}
+            return {}
+    """, rules=[fl008_protocol])
+    assert findings == []
+
+
+def test_fl008_dead_knob_and_undeclared_read():
+    findings = lint_tree([
+        ("core/myoptions.py", """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Knobs:
+                live_limit: int = 4
+                dead_limit: int = 9
+        """),
+        ("server/consumer.py", """
+            def f(knobs):
+                return knobs.live_limit + knobs.typo_limit
+        """),
+    ], rules=[fl008_protocol])
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2
+    assert "dead knob" in msgs[0] and "dead_limit" in msgs[0]
+    assert "undeclared knob read" in msgs[1] and "typo_limit" in msgs[1]
+
+
+# ───────────────────────────── FLSUP ─────────────────────────────
+def test_stale_suppression_fails_the_run():
+    findings = flowlint.lint_source("server/foo.py", textwrap.dedent("""
+        def f():
+            return 1  # flowlint: disable=FL001
+    """))
+    assert rules_of(findings) == [flowlint.SUPPRESSION_RULE]
+    assert "stale suppression" in findings[0].message
+
+
+def test_live_suppression_is_not_stale():
+    findings = flowlint.lint_source("server/foo.py", textwrap.dedent("""
+        import os
+
+        def f():
+            return os.urandom(8)  # flowlint: disable=FL001
+    """))
+    assert findings == []
+
+
+# ─────────────────────── runtime lockdep witness ───────────────────────
+@pytest.fixture
+def witness():
+    """Enabled, empty lockdep state; restores the prior mode after."""
+    was = lockdep.enabled()
+    lockdep.reset()
+    lockdep.enable()
+    yield lockdep
+    lockdep.reset()
+    if not was:
+        lockdep.disable()
+
+
+def test_lockdep_disabled_returns_plain_primitives():
+    was = lockdep.enabled()
+    lockdep.disable()
+    try:
+        lk = lockdep.lock("X._lock")
+        assert type(lk) is type(threading.Lock())
+        cv = lockdep.condition("X._cv")
+        assert isinstance(cv, threading.Condition)
+    finally:
+        if was:
+            lockdep.enable()
+
+
+def test_lockdep_records_adjacency_not_closure(witness):
+    a = witness.lock("T._a")
+    b = witness.lock("T._b")
+    c = witness.lock("T._c")
+    with a:
+        with b:
+            with c:
+                pass
+    assert witness.edge_set() == {("T._a", "T._b"), ("T._b", "T._c")}
+    assert witness.cycle_count() == 0
+
+
+def test_lockdep_detects_abba_cycle(witness):
+    a = witness.lock("T._a")
+    b = witness.lock("T._b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert witness.cycle_count() == 1
+    (path,) = witness.cycles()
+    assert path[0] == path[-1] == "T._a"
+    assert "T._b" in path
+
+
+def test_lockdep_sibling_instances_share_a_class_node(witness):
+    """Two instances of the same class are ONE witness node: nesting
+    them records no self-edge (matches the static model's class-keyed
+    lock ids)."""
+    a1 = witness.lock("T._mu")
+    a2 = witness.lock("T._mu")
+    with a1:
+        with a2:
+            pass
+    assert witness.edge_set() == frozenset()
+
+
+def test_lockdep_condition_wait_releases_the_node(witness):
+    """A Condition over an instrumented lock must release the node
+    during wait() — otherwise every wakeup records phantom edges."""
+    mu = witness.lock("T._mu")
+    cv = witness.condition("T._mu", mu)
+    other = witness.lock("T._other")
+
+    def waker():
+        with other:
+            with cv:
+                cv.notify_all()
+
+    with cv:
+        t = threading.Thread(target=waker, name="waker", daemon=True)
+        t.start()
+        cv.wait(timeout=5)
+    t.join(timeout=5)
+    # the waiter held nothing while parked, so the waker's nesting is
+    # the only edge — and no (T._mu, T._mu) self-edge ever appears
+    assert witness.edge_set() == {("T._other", "T._mu")}
+    assert witness.cycle_count() == 0
+
+
+def test_lockdep_reset_clears_everything(witness):
+    a = witness.lock("T._a")
+    b = witness.lock("T._b")
+    with a:
+        with b:
+            pass
+    assert witness.edge_set()
+    witness.reset()
+    assert witness.edge_set() == frozenset()
+    assert witness.cycle_count() == 0
+    assert witness.acquisition_count() == 0
+
+
+def test_lockdep_freezes_after_quiet_streak(witness, monkeypatch):
+    monkeypatch.setattr(lockdep, "_FREEZE_AFTER", 5)
+    a = witness.lock("T._a")
+    b = witness.lock("T._b")
+    c = witness.lock("T._c")
+    for _ in range(10):  # same edge over and over: converges, freezes
+        with a:
+            with b:
+                pass
+    with a:  # post-freeze discovery is skipped by design
+        with c:
+            pass
+    assert witness.edge_set() == {("T._a", "T._b")}
+
+
+def test_lockdep_witness_doc_is_canonical(witness):
+    a = witness.lock("T._a")
+    b = witness.lock("T._b")
+    with a:
+        with b:
+            pass
+    doc = witness.witness_doc()
+    assert doc == json.dumps(json.loads(doc), sort_keys=True,
+                             separators=(",", ":"))
+    assert json.loads(doc) == {"edges": [["T._a", "T._b"]], "cycles": []}
+
+
+def _run_witness_sim(seed, tmp_path):
+    from foundationdb_tpu.sim.simulation import Simulation
+    from foundationdb_tpu.sim.workloads import (
+        cycle_check, cycle_setup, cycle_workload)
+
+    lockdep.reset()
+    lockdep.enable()
+    try:
+        sim = Simulation(seed=seed, buggify=True, crash_p=0.004,
+                         datadir=str(tmp_path))
+        n = 12
+        cycle_setup(sim.db, n)
+        for a in range(2):
+            rng = random.Random(seed * 1000 + a)
+            sim.add_workload(f"cycle{a}",
+                             cycle_workload(sim.db, n, 15, rng))
+        sim.run()
+        sim.quiesce()
+        cycle_check(sim.db, n)
+        sim.close()
+        return lockdep.witness_doc()
+    finally:
+        lockdep.reset()
+        lockdep.disable()
+
+
+def test_same_seed_sims_emit_identical_witness_docs(tmp_path):
+    """The determinism contract from the module docstring: canonical
+    witness documents from two same-seed sims are byte-identical."""
+    a = _run_witness_sim(29, tmp_path / "a")
+    b = _run_witness_sim(29, tmp_path / "b")
+    assert a == b
+    assert json.loads(a)["cycles"] == []
+
+
+def test_dynamic_edges_are_a_subset_of_the_static_graph(tmp_path):
+    """The binding contract between the two halves: every acquisition
+    order the runtime witness observes must already be an edge in the
+    FL006 static graph (the static pass over-approximates; a dynamic
+    edge it missed is a resolver bug)."""
+    doc = json.loads(_run_witness_sim(31, tmp_path / "w"))
+    assert doc["edges"], "sim exercised no nested acquisition at all"
+
+    pkg = flowlint.package_dir()
+    paths = list(flowlint.iter_py_files([pkg]))
+    root = os.path.dirname(pkg)
+    items = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            items.append((flowlint.module_relpath(p, root), f.read()))
+    model = flowlint.build_tree_model(items)
+    static_edges, _funcs = fl006_lockorder.compute_graph(model)
+    static = set(static_edges)
+    dynamic = {tuple(e) for e in doc["edges"]}
+    assert dynamic <= static, (
+        "runtime witness observed acquisition orders the static FL006 "
+        f"graph does not predict: {sorted(dynamic - static)}")
+    assert doc["cycles"] == []
+
+
+# ───────────────────── thread hygiene audit ─────────────────────
+def _thread_sites():
+    pkg = flowlint.package_dir()
+    for path in flowlint.iter_py_files([pkg]):
+        if os.sep + "analysis" + os.sep in path:
+            continue  # the linter's own docs/fixtures mention Thread
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr == "Thread" and \
+                        isinstance(fn.value, ast.Name) and \
+                        fn.value.id == "threading":
+                    yield path, node
+
+
+def test_every_thread_site_is_named_and_daemonized():
+    """Every ``threading.Thread(`` in the package carries ``name=``
+    (debuggable stacks, py-spy output) and an explicit ``daemon=``
+    (teardown policy is a decision, not a default)."""
+    sites = list(_thread_sites())
+    assert len(sites) >= 8, f"expected >=8 thread sites, saw {len(sites)}"
+    for path, node in sites:
+        kwargs = {kw.arg for kw in node.keywords}
+        assert "name" in kwargs, f"{path}:{node.lineno} Thread lacks name="
+        assert "daemon" in kwargs, \
+            f"{path}:{node.lineno} Thread lacks explicit daemon="
+
+
+def test_batcher_close_joins_its_threads():
+    """BatchingCommitProxy.close() must join the batcher (and apply)
+    threads so teardown never races a live flusher."""
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.server.batcher import BatchingCommitProxy
+    from foundationdb_tpu.utils.metrics import MetricsRegistry
+
+    class _Inner:
+        knobs = Knobs()
+        metrics = MetricsRegistry("test")
+
+        def commit_batch(self, reqs):
+            return [("committed", 1, 0)] * len(reqs)
+
+    bp = BatchingCommitProxy(_Inner(), mode="thread")
+    threads = [t for t in (bp._thread, bp._apply_thread) if t is not None]
+    assert threads, "thread-mode batcher spawned no flusher"
+    bp.close()
+    for t in threads:
+        assert not t.is_alive(), f"{t.name} still alive after close()"
+
+
+def test_read_batcher_close_joins_its_flusher():
+    from foundationdb_tpu.txn.futures import ReadBatcher
+
+    rb = ReadBatcher(send=lambda ops: [b"v"] * len(ops), thread=True)
+    t = rb._thread
+    assert t is not None and t.is_alive()
+    rb.close()
+    assert not t.is_alive(), "read-batcher flusher still alive after close()"
+
+
+def test_rpc_client_close_joins_reader():
+    """RpcClient.close() must join the reader thread — no thread left
+    touching a dead socket after close returns."""
+    from foundationdb_tpu.rpc.transport import RpcClient, RpcServer
+
+    srv = RpcServer("127.0.0.1", 0, {"ping": lambda: "pong"})
+    try:
+        cli = RpcClient("127.0.0.1", srv.port)
+        assert cli.call("ping") == "pong"
+        reader = cli._reader
+        assert reader.is_alive()
+        cli.close()
+        assert not reader.is_alive(), "reader still alive after close()"
+    finally:
+        srv.close()
