@@ -543,6 +543,10 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         # bounded multi-stage commit pipeline (server/batcher.py):
         # pack+resolve of group N+1 overlaps the apply of group N
         commit_pipeline_depth=int(env("BENCH_PIPELINE_DEPTH", 2)),
+        # cluster doctor: probe cadence — health_smoke tightens it so a
+        # short window still collects a meaningful probe band
+        health_probe_interval_s=float(
+            env("BENCH_HEALTH_PROBE_INTERVAL", 1.0)),
     )
     db = cluster.database()
     # warm the pipeline (first batch jit-compiles the resolver kernel,
@@ -707,6 +711,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     for t in threads:
         t.join(timeout=90)
     elapsed = time.perf_counter() - t0
+    # cluster doctor (ISSUE 13): snapshot health BEFORE close() — the
+    # verdict reads live role liveness, which close() tears down
+    hdoc = cluster.health_status()
     cluster.close()  # batcher + grv threads, pools, engine/WAL handles
     if errors:
         raise errors[0]
@@ -808,6 +815,15 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "device_dispatches": dev["dispatches"],
         "staging_reuse_rate": dev["staging_reuse_rate"],
         "transfer_bytes": dev["transfer_bytes"],
+        # cluster doctor (ISSUE 13): the health rollup on every e2e
+        # line — live probe bands (0 when the prober hasn't fired in a
+        # short run), the recovery timeline's count/duration, and the
+        # machine-checkable verdict the doctor CLI gates on
+        "probe_grv_p99_ms": hdoc["probe"]["grv"].get("p99_ms", 0.0),
+        "probe_commit_p99_ms": hdoc["probe"]["commit"].get("p99_ms", 0.0),
+        "recovery_count": hdoc["recovery"]["count"],
+        "last_recovery_ms": hdoc["recovery"]["last_recovery_ms"],
+        "health_verdict": hdoc["verdict"],
         # distributed tracing: how many transactions carried a sampled
         # trace this run (0 when the knob is off — the field rides
         # every line so its absence is never ambiguous)
@@ -1775,6 +1791,68 @@ def run_metrics_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_health_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=health_smoke: the cluster-doctor subsystem's overhead
+    budget, measured — the ycsb e2e with the latency prober + health
+    rollups ENABLED vs the health kill switch OFF, interleaved pairs,
+    median throughput each, ≤2% budget (the metrics_smoke protocol).
+    The enabled arm's probe bands / verdict ride along so the smoke
+    also proves the prober actually committed real probe transactions
+    under the measured load."""
+    from foundationdb_tpu.server import health as health_mod
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    # probe aggressively for the smoke: the default 1s cadence would
+    # land ~1 probe in a 2s window — too few for a meaningful band
+    os.environ.setdefault("BENCH_HEALTH_PROBE_INTERVAL", "0.2")
+    backend = "native"
+    runs = {True: [], False: []}
+    fields_on = None
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                health_mod.set_enabled(on)
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    fields_on = r
+    finally:
+        health_mod.set_enabled(True)
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_health_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "health_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": fields_on.get("platform"),
+        "probe_grv_p99_ms": fields_on.get("probe_grv_p99_ms"),
+        "probe_commit_p99_ms": fields_on.get("probe_commit_p99_ms"),
+        "recovery_count": fields_on.get("recovery_count"),
+        "last_recovery_ms": fields_on.get("last_recovery_ms"),
+        "health_verdict": fields_on.get("health_verdict"),
+        "commit_p50_ms": fields_on.get("commit_p50_ms"),
+        "commit_p99_ms": fields_on.get("commit_p99_ms"),
+        "grv_p99_ms": fields_on.get("grv_p99_ms"),
+    }
+
+
 def run_heatmap_smoke(cpu, seconds=None, rounds=None):
     """BENCH_MODE=heatmap_smoke: the workload-attribution subsystem's
     overhead budget, measured — the ycsb e2e with the heatmap kill
@@ -2315,6 +2393,8 @@ def _compact_summary(out, configs):
               "pad_waste_pct", "bucket_histogram", "recompiles",
               "fallback_causes", "lane_skew_pct",
               "flowlint_findings", "flowlint_by_rule", "lockdep_cycles",
+              "probe_grv_p99_ms", "probe_commit_p99_ms",
+              "recovery_count", "last_recovery_ms", "health_verdict",
               "tpu_recovered", "fallback_from", "error"):
         if out.get(k) is not None:
             line[k] = out[k]
@@ -2361,6 +2441,8 @@ def main():
     # deviceprofile kill switch on vs off, ≤2% budget) |
     # lockdep_smoke (runtime lock-order witness overhead: instrumented
     # vs plain lock factories, ≤2% budget, 0 observed cycles) |
+    # health_smoke (cluster-doctor overhead: latency prober + health
+    # rollups on vs the health kill switch off, ≤2% budget) |
     # read_smoke (loaded read RTT: sync blocking get() vs get_async
     # windows multiplexed into read_batch RPCs, over a real fdbserver
     # process — the ≥3x ISSUE-11 acceptance probe) |
@@ -2456,6 +2538,15 @@ def main():
 
     if mode == "profile_smoke":
         out = run_profile_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # same contract as metrics_smoke: the ≤2% budget is a GATE
+        if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "health_smoke":
+        out = run_health_smoke(cpu)
         watchdog_finish()
         _emit(out)
         # same contract as metrics_smoke: the ≤2% budget is a GATE

@@ -133,6 +133,21 @@ class Knobs:
     # accesses; 16 keeps the sampler inside the 2% overhead budget.
     storage_sample_every: int = 16
 
+    # --- cluster doctor (server/health.py, tools/doctor.py) ---
+    # latency prober: real GRV/read/commit probe transactions against
+    # the live cluster (ref: Status.actor.cpp latencyProbe). Cadence
+    # rides the injected clock + the "latency-probe" deterministic
+    # stream; thread-mode clusters drive it from a daemon loop, sims
+    # call maybe_probe() from their own schedule.
+    health_probe_enabled: bool = True
+    health_probe_interval_s: float = 1.0
+    # doctor SLO thresholds (tools/doctor.py alerts + the storage_lag
+    # degraded reason in the health verdict): probe p99 bounds, max
+    # acceptable recovery duration, max storage durability lag
+    doctor_probe_p99_ms: float = 1000.0
+    doctor_recovery_ms: float = 30_000.0
+    doctor_lag_versions: int = 5_000_000
+
     # --- simulation ---
     # process-global BUGGIFY default (sim/buggify.py): `buggify` arms
     # the module-level BUGGIFY singleton at import (Simulation always

@@ -111,6 +111,9 @@ class ClusterService:
             # the metrics section alone (monitoring agents poll this
             # without paying for the whole status document)
             "metrics": self.metrics,
+            # cluster doctor: verdict + probe bands + recovery timeline
+            # + lag rollups alone (fdbcli `doctor`, tools/doctor.py)
+            "health": self.health,
             # workload attribution: hot ranges + per-tag rollup alone
             # (fdbcli `top`, tools/heatmap.py split-point advice)
             "metrics_hot": self.metrics_hot,
@@ -171,6 +174,9 @@ class ClusterService:
 
     def metrics(self):
         return self.cluster.metrics_status()
+
+    def health(self):
+        return self.cluster.health_status()
 
     def metrics_hot(self, top=None):
         return self.cluster.hot_ranges_status(top=top)
@@ -809,6 +815,9 @@ class RemoteCluster:
 
     def metrics_status(self):
         return self._call("metrics")
+
+    def health_status(self):
+        return self._call("health")
 
     def hot_ranges_status(self, top=None):
         return self._call("metrics_hot", top)

@@ -21,6 +21,7 @@ from foundationdb_tpu.server.coordination import (
 )
 from foundationdb_tpu.server.datadistribution import DataDistributor
 from foundationdb_tpu.server.grv import GrvProxy
+from foundationdb_tpu.server import health as health_mod
 from foundationdb_tpu.server.proxy import CommitProxy
 from foundationdb_tpu.server.ratekeeper import Ratekeeper
 from foundationdb_tpu.server.router import StorageRouter
@@ -236,9 +237,21 @@ class Cluster:
         # thread — two concurrent _recover_txn_system calls would race
         # the generation CAS and tear the frontend swap
         self._recovery_mu = lockdep.lock("Cluster._recovery_mu")
+        # ── cluster doctor (server/health.py) ──
+        # clock_advance: the simulation's hook — recovery phase marks
+        # call it so a simulated recovery consumes simulated time and
+        # same-seed runs agree; None in production (real elapsed time)
+        self.clock_advance = None
+        self.recovery_timeline = health_mod.RecoveryTimeline()
+        self.prober = health_mod.LatencyProber(self)
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()
+        # only thread-mode clusters get the background probe loop; sims
+        # and sync deployments drive maybe_probe() from their own
+        # schedule so determinism is never perturbed
+        if commit_pipeline == "thread" and knobs.health_probe_enabled:
+            self.prober.start()
 
     def _restore_tenant_config(self):
         """Re-apply persisted tenant mode + quotas + lock state after
@@ -453,7 +466,10 @@ class Cluster:
             with self._recovery_mu:
                 if (not self.sequencer.alive
                         or not self._commit_target().alive):
-                    self._recover_txn_system()
+                    trigger = ("sequencer_failed"
+                               if not self.sequencer.alive
+                               else "commit_proxy_failed")
+                    self._recover_txn_system(trigger=trigger)
                     events.append(("txn-system", 0))
         if isinstance(self.tlog, TLogSystem):
             for i, log in enumerate(self.tlog.logs):
@@ -481,7 +497,8 @@ class Cluster:
             TraceEvent("RolesRecruited").detail(events=events).log()
         return events
 
-    def _recover_txn_system(self, new_resolver_lanes=None):
+    def _recover_txn_system(self, new_resolver_lanes=None,
+                            trigger="role_failure"):
         """The recovery state machine for dead sequencer/commit-proxy
         roles (ref: fdbserver/ClusterRecovery.actor.cpp): win a new
         generation at the coordinators (CAS), restart the version
@@ -494,6 +511,10 @@ class Cluster:
         commits could still resolve against the old history."""
         import contextlib
 
+        # recovery-state timeline (server/health.py): each phase mark
+        # closes the phase that just ran; the record lands in the
+        # bounded cluster-owned timeline health_status() reports
+        rec = self.recovery_timeline.begin(trigger, self.clock_advance)
         old_proxy = self.commit_proxy
         old_inners = self._inner_proxies()
         # Quiesce: mark both roles dead FIRST (future batches answer
@@ -512,7 +533,9 @@ class Cluster:
             recovered = max(
                 self.tlog.last_version, self.sequencer.committed_version
             )
+        rec.phase("fence")
         gen = self.generation = self._win_generation(recovered)
+        rec.phase("cas")
         self.sequencer = Sequencer(
             version_clock=self.sequencer.version_clock,
             start_version=recovered,
@@ -549,12 +572,14 @@ class Cluster:
         tenant_mode = getattr(old_inners[0], "tenant_mode", None)
         old_grv = self.grv_proxy
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
+        rec.phase("recruit")
         target = self._commit_target()
         if lock_uid is not None:
             target.lock_uid = lock_uid
         if tenant_mode is not None:
             target.tenant_mode = tenant_mode
         target.update_resolver_ranges(fence=False)
+        rec.phase("replay")
         if self.commit_pipeline != "sync":
             # queued commits raced the death: resolve them 1021 so
             # their clients retry against the new generation
@@ -562,8 +587,11 @@ class Cluster:
         old_proxy.close()
         if hasattr(old_grv, "close"):
             old_grv.close()
+        rec.phase("accept")
+        rec.finish(gen, recovered)
         TraceEvent("TxnSystemRecovered").detail(
-            generation=gen, version=recovered).log()
+            generation=gen, version=recovered, trigger=trigger,
+            recovery_ms=rec.record["total_ms"]).log()
 
     def _recruit_storage(self, sid):
         """Replace a dead storage by rebooting onto its durable engine
@@ -615,6 +643,7 @@ class Cluster:
     def close(self):
         """Release background machinery (batcher threads, thread pools)
         and durable handles."""
+        self.prober.stop()
         if hasattr(self.grv_proxy, "close"):
             self.grv_proxy.close()
         if hasattr(self.commit_proxy, "close"):
@@ -797,7 +826,8 @@ class Cluster:
                     self._requested_resolver_lanes = lanes
                     changed = True
             if changed:
-                self._recover_txn_system(new_resolver_lanes=lanes)
+                self._recover_txn_system(new_resolver_lanes=lanes,
+                                         trigger="configure")
         return {"commit_proxies": self.n_commit_proxies,
                 "resolver_lanes": self.resolver_lanes()}
 
@@ -1129,6 +1159,14 @@ class Cluster:
             "aggregate": deviceprofile.merged_snapshot(profs),
         }
 
+    def health_status(self):
+        """The ``cluster.health`` document (``health`` RPC /
+        \\xff\\xff/status/health / fdbcli doctor / tools/doctor.py):
+        doctor verdict + reasons + FDB-style messages, probe latency
+        bands, the recovery timeline, and the lag/saturation rollups —
+        a pure read (no probe fires here)."""
+        return health_mod.build_health(self)
+
     def _trace_status(self):
         """The trace/span pipeline's own health: per-type suppression
         (satellite of flow/Trace.cpp event suppression) and the tracing
@@ -1210,6 +1248,10 @@ class Cluster:
                     "tags": hot["tags"],
                 },
                 "metrics": self.metrics_status(),
+                # cluster doctor (server/health.py): verdict + reasons +
+                # messages + probe bands + recovery timeline + lag
+                # rollups — what fdbcli doctor and tools/doctor.py read
+                "health": self.health_status(),
                 # device-path execution profile (utils/deviceprofile.py):
                 # the resolver dispatch layer's pad/bucket/fallback
                 # accounting, cluster-owned like metrics/heatmaps above

@@ -72,6 +72,12 @@ class Ratekeeper:
         # Ratekeeper feeds in Status.actor.cpp); values are set from the
         # live fields at snapshot time, so admission pays nothing
         self.metrics = metrics_mod.MetricsRegistry("ratekeeper")
+        # per-reason denial COUNTERS (not snapshot-time gauges): the
+        # registry survives recovery, so throttle causes accumulate
+        # across incarnations and show in benchdiff trajectories — the
+        # signal the cluster doctor's saturation rollup reads
+        self._m_denied_tag = self.metrics.counter("admit_denied_tag")
+        self._m_denied_budget = self.metrics.counter("admit_denied_budget")
 
     # ── GRV-edge enforcement (ref: GrvProxy transaction budgets) ──
     def admit(self, priority="default", tags=()):
@@ -165,6 +171,7 @@ class Ratekeeper:
             b[1] = now
             if b[0] < 1.0:
                 self.tag_throttled_count += 1
+                self._m_denied_tag.inc()
                 return False, []
             limited.append(b)
         return True, limited
@@ -183,6 +190,7 @@ class Ratekeeper:
             self._tokens -= need
             return True
         self.throttled_count += 1
+        self._m_denied_budget.inc()
         return False
 
     def _note_admit_locked(self, tags):

@@ -141,6 +141,13 @@ class Simulation:
         os.makedirs(self.datadir, exist_ok=True)
         self.recoveries = 0
         self.steps = 0
+        # simulated-time skew consumed by recovery phase marks (the
+        # cluster's clock_advance hook): deterministic.now() reads
+        # steps*SIM_DT + skew, so phase durations are nonzero, bounded,
+        # and identical under a seed — while the ratekeeper and trace
+        # clocks stay on the pure step clock, leaving admission and
+        # trace output of existing seeds untouched
+        self.clock_skew = 0.0
         self.schedule_hash = 0  # order-sensitive digest of scheduling choices
         self._actors = []  # (name, generator)
         # message-level network (ref: sim2): workloads built on
@@ -170,7 +177,9 @@ class Simulation:
         global_trace_log().clock = lambda: self.steps
         # the registry's injected clock follows simulated time too, so
         # deterministic.now() readers replay with the schedule
-        deterministic.set_clock(lambda: self.steps * self.SIM_DT)
+        deterministic.set_clock(
+            lambda: self.steps * self.SIM_DT + self.clock_skew
+        )
         n_storage = self.cluster_kwargs.get("n_storage", 1)
         self.cluster = Cluster(
             wal_path=self._wal_path,
@@ -187,6 +196,10 @@ class Simulation:
             rk_clock=lambda: self.steps * self.SIM_DT,
             **self.cluster_kwargs,
         )
+        # recovery phase marks consume one simulated tick each: the
+        # timeline's per-phase durations come out nonzero and replay
+        # byte-identically under a seed
+        self.cluster.clock_advance = self._advance_clock
         self.cluster.commit_proxy = FaultyCommitProxy(
             self.cluster.commit_proxy, self.buggify
         )
@@ -195,6 +208,9 @@ class Simulation:
         # batching every step, and a per-step hasattr through the fault
         # wrapper's __getattr__ would pay an exception per miss
         self._pump = getattr(self.cluster.commit_proxy, "pump", None)
+
+    def _advance_clock(self):
+        self.clock_skew += self.SIM_DT
 
     def crash_and_recover(self):
         """Kill the cluster (losing all volatile state) and restart from

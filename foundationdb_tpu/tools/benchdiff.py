@@ -37,6 +37,12 @@ LOWER_BETTER = (
     # a regression ("lockdep_overhead_pct" already resolves via
     # "overhead_pct" above; "flowlint" also covers flowlint_by_rule.*)
     "flowlint", "lockdep_cycles",
+    # cluster doctor (ISSUE 13): probe_grv_p99_ms / probe_commit_p99_ms
+    # / last_recovery_ms already resolve lower-better via "_ms" above;
+    # more recoveries, failed probes, admission denials, deeper queues,
+    # and durability lag are all regressions
+    "recovery_count", "probe_failures", "admit_denied", "queue_depth",
+    "lag_versions",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",

@@ -139,6 +139,7 @@ class Cli:
             "  throttle list|on tag T TPS|off tag T   per-tag throttling",
             "  top [conflict|read|write] [K]   hottest key ranges + tags",
             "  profile [json]                  device-path dispatch profile",
+            "  doctor [json]                   health verdict + SLO alerts",
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
@@ -633,6 +634,45 @@ class Cli:
                 f"pad_waste_pct={r.get('pad_waste_pct')} "
                 f"recompiles={r.get('recompiles')}{lane_note}"
             )
+
+
+    def _cmd_doctor(self, args):
+        """Cluster doctor (ref: the health checks operators run through
+        fdbcli status details): verdict, reasons, probe latency bands,
+        recovery timeline, and SLO alerts — read through the
+        ``\\xff\\xff/status/health`` special key so the same command
+        works against remote clusters."""
+        from foundationdb_tpu.tools import doctor as doctor_mod
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        doc = json.loads(self._run(lambda tr: tr.get(sk.HEALTH)))
+        if args and args[0] == "json":
+            self._p(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        alerts, verdict = doctor_mod.check(doc)
+        probe = doc.get("probe", {})
+        rec = doc.get("recovery", {})
+        lag = doc.get("lag", {})
+        self._p(
+            f"Cluster health: {verdict}",
+            f"  Probes              - {probe.get('probes', 0)} "
+            f"({probe.get('failures', 0)} failed)",
+            f"  GRV p99 (ms)        - "
+            f"{probe.get('grv', {}).get('p99_ms', 0.0)}",
+            f"  Commit p99 (ms)     - "
+            f"{probe.get('commit', {}).get('p99_ms', 0.0)}",
+            f"  Recoveries          - {rec.get('count', 0)} "
+            f"(last {rec.get('last_recovery_ms', 0.0)} ms, "
+            f"generation {rec.get('generation', 0)})",
+            f"  Durability lag      - "
+            f"{lag.get('durability_lag_versions_max', 0)} versions",
+        )
+        for m in doc.get("messages", ()):
+            self._p(f"  message: {m['name']} — {m['description']}")
+        for a in alerts:
+            self._p(f"  ALERT {a}")
+        if not alerts:
+            self._p("  No alerts.")
 
 
 def main(argv=None):

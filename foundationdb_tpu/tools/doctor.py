@@ -1,0 +1,169 @@
+r"""Cluster doctor watchdog: tail health, alert on SLO violations.
+
+Ref parity: the operator loop around fdbcli's status details /
+``cluster.messages`` — a watchdog that polls the health document the
+cluster already computes (server/health.py) and turns it into
+machine-checkable alerts with a nonzero exit code, so CI and chaos
+scenarios can chain it::
+
+    python -m foundationdb_tpu.tools.doctor --connect host:4500
+    python -m foundationdb_tpu.tools.doctor --status-file status.json
+    python -m foundationdb_tpu.tools.doctor --connect host:4500 --watch 0
+
+``check()`` is pure (one health doc + thresholds in, alerts out) — the
+sim chaos tests drive it directly against in-process clusters. SLO
+thresholds default from the doctor_* knobs (core/options.py) and can be
+overridden per flag.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+from foundationdb_tpu.core.options import DEFAULT_KNOBS
+
+DEFAULT_THRESHOLDS = {
+    "probe_p99_ms": DEFAULT_KNOBS.doctor_probe_p99_ms,
+    "recovery_ms": DEFAULT_KNOBS.doctor_recovery_ms,
+    "lag_versions": DEFAULT_KNOBS.doctor_lag_versions,
+}
+
+
+def check(health, thresholds=None):
+    """One health document → ``(alerts, verdict)``. Pure and
+    deterministic: the same doc and thresholds always yield the same
+    alerts, so same-seed sims produce identical doctor output."""
+    th = dict(DEFAULT_THRESHOLDS)
+    if thresholds:
+        th.update({k: v for k, v in thresholds.items() if v is not None})
+    alerts = []
+    verdict = health.get("verdict", "unknown")
+    if verdict != "healthy":
+        messages = health.get("messages") or [
+            {"name": r, "description": r}
+            for r in health.get("reasons", ())
+        ]
+        if not messages:
+            alerts.append(f"{verdict}: cluster is not healthy")
+        for m in messages:
+            alerts.append(f"{verdict}: {m['name']} — {m['description']}")
+    probe = health.get("probe", {})
+    for hop in ("grv", "commit"):
+        bands = probe.get(hop) or {}
+        if bands.get("count") and bands.get("p99_ms", 0) \
+                > th["probe_p99_ms"]:
+            alerts.append(
+                f"slo: probe {hop} p99 {bands['p99_ms']}ms exceeds "
+                f"{th['probe_p99_ms']}ms"
+            )
+    rec = health.get("recovery", {})
+    last_ms = rec.get("last_recovery_ms", 0) or 0
+    if last_ms > th["recovery_ms"]:
+        alerts.append(
+            f"slo: last recovery took {last_ms}ms, over "
+            f"{th['recovery_ms']}ms"
+        )
+    lag = health.get("lag", {}).get("durability_lag_versions_max", 0) or 0
+    if lag > th["lag_versions"]:
+        alerts.append(
+            f"slo: storage durability lag {lag} versions exceeds "
+            f"{th['lag_versions']}"
+        )
+    return alerts, verdict
+
+
+def extract_health(doc):
+    """Accept a bare health doc, a full status doc, or its ``cluster``
+    section — whichever the source produced."""
+    if not isinstance(doc, dict):
+        return {}
+    if "verdict" in doc:
+        return doc
+    if "cluster" in doc:
+        return doc["cluster"].get("health", {})
+    return doc.get("health", {})
+
+
+def _report(health, alerts, verdict, as_json, out):
+    if as_json:
+        print(json.dumps(
+            {"verdict": verdict, "alerts": alerts,
+             "reasons": health.get("reasons", []),
+             "recovery_count": health.get("recovery", {}).get("count", 0)},
+            sort_keys=True), file=out)
+        return
+    probe = health.get("probe", {})
+    rec = health.get("recovery", {})
+    print(
+        f"doctor: {verdict} "
+        f"(probes={probe.get('probes', 0)} "
+        f"failures={probe.get('failures', 0)} "
+        f"recoveries={rec.get('count', 0)} "
+        f"last_recovery_ms={rec.get('last_recovery_ms', 0)})",
+        file=out,
+    )
+    for a in alerts:
+        print(f"  ALERT {a}", file=out)
+
+
+def main(argv=None, out=None, sleep=time.sleep):
+    out = out if out is not None else sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.tools.doctor",
+        description="Watchdog over the cluster.health document.",
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--connect", metavar="HOST:PORT",
+                     help="poll a remote cluster's health RPC")
+    src.add_argument("--status-file", metavar="PATH",
+                     help="re-read a health/status JSON file each round")
+    ap.add_argument("--watch", type=int, default=None, metavar="N",
+                    help="poll N rounds (0 = forever); default: once")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between watch rounds")
+    ap.add_argument("--probe-p99-ms", type=float, default=None)
+    ap.add_argument("--recovery-ms", type=float, default=None)
+    ap.add_argument("--lag-versions", type=int, default=None)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ns = ap.parse_args(argv)
+    thresholds = {
+        "probe_p99_ms": ns.probe_p99_ms,
+        "recovery_ms": ns.recovery_ms,
+        "lag_versions": ns.lag_versions,
+    }
+
+    remote = None
+    if ns.connect:
+        from foundationdb_tpu.rpc.service import RemoteCluster
+
+        remote = RemoteCluster([ns.connect])
+
+    def poll():
+        if remote is not None:
+            return remote.health_status()
+        with open(ns.status_file) as f:
+            return extract_health(json.load(f))
+
+    try:
+        rounds = 1 if ns.watch is None else ns.watch
+        n = 0
+        alerts, verdict = [], "unknown"
+        while True:
+            health = poll()
+            alerts, verdict = check(health, thresholds)
+            _report(health, alerts, verdict, ns.as_json, out)
+            n += 1
+            if rounds and n >= rounds:
+                break
+            sleep(ns.interval)
+    finally:
+        if remote is not None:
+            remote.close()
+    # the chainable contract: nonzero exactly when the LAST round
+    # alerted, so `doctor && next-step` gates on current health
+    return 1 if alerts else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,16 +11,49 @@ cross-checkable against ``stage_summary()``'s hottest stage).
 
 Usage::
 
-    python -m foundationdb_tpu.tools.tracing trace.json [trace.json.1 …]
+    python -m foundationdb_tpu.tools.tracing trace.json
 
-or programmatically: ``report(spans)`` over ``load_spans(...)`` /
+Rolled siblings are stitched automatically: the rolling file sink
+(utils/trace.py) rotates ``path`` → ``path.1`` → … → ``path.N`` with
+``path.N`` the oldest, so giving the live path reads the WHOLE history
+oldest-first instead of silently analyzing only the newest fragment.
+
+Programmatically: ``report(spans)`` over ``load_spans(...)`` /
 in-memory ``events("Span")`` dicts from a TraceLog ring buffer.
 """
 
 import json
+import os
 import sys
 
 STAGE_PREFIX = "stage."
+
+
+def rolled_files(path):
+    """The rolled family of a live trace path, oldest first:
+    ``path.N … path.1 path`` (the rolling sink shifts contiguously, so
+    the scan stops at the first missing index). A path with no rolls —
+    or an explicitly-given ``path.K`` sibling — returns just itself."""
+    rolls = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rolls.append(f"{path}.{i}")
+        i += 1
+    out = list(reversed(rolls))
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
+def stitch(paths):
+    """Expand each given path through its rolled family, deduplicated
+    and ordered oldest-first per family."""
+    out = []
+    for p in paths:
+        for q in rolled_files(p):
+            if q not in out:
+                out.append(q)
+    return out
 
 
 def load_spans(paths):
@@ -180,9 +213,11 @@ def main(argv=None):
         description="reconstruct span trees from trace files and "
                     "report per-hop latency + critical-path attribution",
     )
-    ap.add_argument("files", nargs="+", help="trace files (JSON lines)")
+    ap.add_argument("files", nargs="+",
+                    help="trace files (JSON lines); rolled .1….N "
+                         "siblings are stitched in automatically")
     ns = ap.parse_args(argv)
-    spans = load_spans(ns.files)
+    spans = load_spans(stitch(ns.files))
     print(json.dumps(report(spans), indent=2, sort_keys=True))
     return 0
 

@@ -33,6 +33,10 @@ def contains(key):
     return isinstance(key, bytes) and key.startswith(PREFIX) and key < END
 
 STATUS_JSON = b"\xff\xff/status/json"
+# cluster doctor (server/health.py): verdict + reasons + messages +
+# probe bands + recovery timeline + lag rollups, without the rest of
+# the status doc — what `fdbcli doctor` and tools/doctor.py poll
+HEALTH = b"\xff\xff/status/health"
 METRICS_JSON = b"\xff\xff/metrics/json"
 # workload attribution (utils/heatmap.py): fleet-merged conflict/read/
 # write hot ranges + per-tag rollup, without the rest of the status doc
@@ -126,6 +130,18 @@ def _device_json(tr):
     return json.dumps(doc, sort_keys=True).encode()
 
 
+def _health_json(tr):
+    """The cluster.health document alone (doctor verdict, probe bands,
+    recovery timeline, lag rollups) — what `fdbcli doctor` and
+    tools/doctor.py poll."""
+    cluster = tr._cluster
+    if hasattr(cluster, "health_status"):
+        doc = cluster.health_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        doc = tr.db.status().get("cluster", {}).get("health", {})
+    return json.dumps(doc, sort_keys=True).encode()
+
+
 def _tracing_rows(tr):
     """The tracing module's materialized rows (cluster config + this
     transaction's token), RYW-overlaid with pending tracing writes."""
@@ -171,6 +187,8 @@ def _tracing_config(tr):
 def get(tr, key):
     if key == STATUS_JSON:
         return json.dumps(tr.db.status(), sort_keys=True).encode()
+    if key == HEALTH:
+        return _health_json(tr)
     if key == METRICS_JSON:
         return _metrics_json(tr)
     if key == HOT_RANGES:
@@ -209,6 +227,8 @@ def get_range(tr, begin, end, limit=0, reverse=False):
     rows = []
     if begin <= STATUS_JSON < end:
         rows.append((STATUS_JSON, get(tr, STATUS_JSON)))
+    if begin <= HEALTH < end:
+        rows.append((HEALTH, get(tr, HEALTH)))
     if begin <= METRICS_JSON < end:
         rows.append((METRICS_JSON, get(tr, METRICS_JSON)))
     if begin <= HOT_RANGES < end:

@@ -44,6 +44,11 @@ def test_compact_summary_is_small_and_headline_last():
         "flowlint_findings": 0,
         "flowlint_by_rule": {},
         "lockdep_cycles": 0,
+        # cluster doctor (ISSUE 13): probe bands, recovery timeline,
+        # machine-checkable verdict
+        "probe_grv_p99_ms": 0.06, "probe_commit_p99_ms": 9.8,
+        "recovery_count": 1, "last_recovery_ms": 12.5,
+        "health_verdict": "healthy",
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -95,6 +100,13 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["recompiles"] == 2
     assert line["lane_skew_pct"] == 12.0
     assert line["fallback_causes"] == {"flat_to_legacy": 1}
+    # the doctor's health rollup rides the summary: probe bands, the
+    # recovery count/duration, and the verdict the watchdog gates on
+    assert line["probe_grv_p99_ms"] == 0.06
+    assert line["probe_commit_p99_ms"] == 9.8
+    assert line["recovery_count"] == 1
+    assert line["last_recovery_ms"] == 12.5
+    assert line["health_verdict"] == "healthy"
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -181,8 +193,17 @@ def test_e2e_line_folds_proxies_and_platform():
                 # read multiplexing (ISSUE 11): every line carries the
                 # batch-size percentiles and the coalesce rate
                 "read_batch_p50", "read_batch_p99",
-                "read_batch_coalesce_rate"):
+                "read_batch_coalesce_rate",
+                # cluster doctor (ISSUE 13): every line carries the
+                # probe bands, recovery timeline, and health verdict
+                "probe_grv_p99_ms", "probe_commit_p99_ms",
+                "recovery_count", "last_recovery_ms",
+                "health_verdict"):
         assert key in fields, key
+    # no fault was injected and nothing recovered: the doctor must say
+    # healthy with an empty recovery timeline
+    assert fields["health_verdict"] == "healthy"
+    assert fields["recovery_count"] == 0
     # in-process clusters resolve async reads inline (determinism), so
     # the batching gauges are exactly zero here — nonzero would mean
     # the sim-deterministic path started batching
@@ -236,6 +257,30 @@ def test_metrics_smoke_contract():
     from foundationdb_tpu.utils import metrics as metrics_mod
 
     assert metrics_mod.enabled()
+
+
+def test_health_smoke_contract():
+    """BENCH_MODE=health_smoke: the cluster-doctor overhead probe emits
+    the budget fields plus the probe-band/recovery/verdict gauges from
+    the enabled arm, and restores the kill switch. One short round
+    checks the contract; the bench run owns the statistically serious
+    comparison."""
+    out = bench.run_health_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "health_overhead_pct", "overhead_budget_pct",
+                "within_budget", "probe_grv_p99_ms",
+                "probe_commit_p99_ms", "recovery_count",
+                "last_recovery_ms", "health_verdict"):
+        assert key in out, key
+    assert out["metric"] == "e2e_health_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the enabled arm's doctor saw a healthy, never-recovered cluster
+    assert out["health_verdict"] == "healthy"
+    assert out["recovery_count"] == 0
+    # the probe restored the kill switch (the doctor stays default-on)
+    from foundationdb_tpu.server import health as health_mod
+
+    assert health_mod.enabled()
 
 
 def test_heatmap_smoke_contract():

@@ -233,6 +233,18 @@ def test_remote_status_and_knobs(remote_db):
     assert db._cluster.knobs.batch_txn_capacity == cluster.knobs.batch_txn_capacity
 
 
+def test_remote_health_status(remote_db):
+    """The doctor's RPC surface: RemoteCluster.health_status() returns
+    the served cluster's live health document, wire-clean."""
+    db, cluster, _ = remote_db
+    h = db._cluster.health_status()
+    assert h["verdict"] == "healthy"
+    assert set(h) >= {"probe", "recovery", "lag", "ratekeeper",
+                      "reasons", "messages"}
+    # served and local documents agree on the machine-checkable parts
+    assert h["verdict"] == cluster.health_status()["verdict"]
+
+
 def test_commit_unknown_result_on_lost_connection():
     cluster = Cluster(resolver_backend="cpu", **TEST_KNOBS)
     server = serve_cluster(cluster)

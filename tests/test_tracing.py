@@ -506,3 +506,35 @@ def test_status_exposes_trace_section():
         assert doc["tracing"]["enabled"] is False
     finally:
         c.close()
+
+
+def test_rolled_trace_files_are_stitched_oldest_first(tmp_path):
+    """The rolling sink rotates path → path.1 → … → path.N (path.N the
+    oldest); giving the tool the live path must analyze the WHOLE rolled
+    history, oldest-first, not just the newest fragment."""
+    path = str(tmp_path / "trace.json")
+    t = "b" * 16
+    # oldest (rolled twice) holds the root; mid holds the commit; the
+    # live file holds a grandchild — only a stitched read connects them
+    files = {
+        f"{path}.2": [_mk("transaction", t, "r", "0" * 16, 9.0)],
+        f"{path}.1": [_mk("txn.commit", t, "c", "r", 6.0)],
+        path: [_mk("stage.resolve", t, "s", "c", 4.0)],
+    }
+    for p, events in files.items():
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    assert tracetool.rolled_files(path) == [f"{path}.2", f"{path}.1", path]
+    # an explicitly-named rolled sibling reads only itself
+    assert tracetool.rolled_files(f"{path}.1") == [f"{path}.1"]
+    # stitch deduplicates families: live path + a sibling = one family
+    assert tracetool.stitch([path, f"{path}.1"]) == \
+        [f"{path}.2", f"{path}.1", path]
+    spans = tracetool.load_spans(tracetool.stitch([path]))
+    assert len(spans) == 3
+    rep = tracetool.report(spans)
+    # the cross-file parent links resolved: the tree is connected
+    assert rep["traces"] == 1
+    assert rep["hottest_edge"] == "transaction->txn.commit"
+    assert rep["hottest_stage"] == "resolve"
