@@ -148,6 +148,27 @@ class Knobs:
     doctor_recovery_ms: float = 30_000.0
     doctor_lag_versions: int = 5_000_000
 
+    # --- multi-region replication (server/region.py) ---
+    # continuous satellite streamer cadence: the RegionReplicator drains
+    # the primary log toward the satellite at most once per interval
+    # (jittered off the "region-stream" deterministic stream — the same
+    # FL001 seam as the latency prober). Thread-mode clusters drive it
+    # from a daemon loop; sims call maybe_stream() from their schedule.
+    region_stream_interval_s: float = 0.05
+    # doctor SLO thresholds for the regions section of cluster.health:
+    # replication lag (versions) before the region_lag degraded reason
+    # fires, and the longest acceptable region failover duration
+    doctor_region_lag_versions: int = 2_000_000
+    doctor_region_failover_ms: float = 60_000.0
+
+    # --- per-tag auto-throttling (server/ratekeeper.py) ---
+    # admission share above which a tag auto-throttles EVEN WITHOUT
+    # global pressure (ref: TagThrottler's standalone busy-tag policy;
+    # the under-pressure AIMD path is always on). 1.0 disables the
+    # standalone path — a share can never exceed 1.0 — matching the
+    # reference's default of auto-throttling being opt-in.
+    tag_throttle_busyness: float = 1.0
+
     # --- simulation ---
     # process-global BUGGIFY default (sim/buggify.py): `buggify` arms
     # the module-level BUGGIFY singleton at import (Simulation always

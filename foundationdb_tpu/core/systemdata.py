@@ -14,6 +14,12 @@ import struct
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"  # '0' = '/'+1
 CONF_REPLICATION = b"\xff/conf/replication"
+# Region configuration row (ref: the region blocks of
+# fdbclient/DatabaseConfiguration.cpp persisted under \xff/conf/) —
+# the canonical JSON of server/region.py's RegionConfig. Riding the
+# ordinary tlog → storage pipeline means the region config is restored
+# by WAL recovery exactly like the shard map above it.
+CONF_REGIONS = b"\xff/conf/regions"
 # Database lock uid (ref: fdbclient/SystemData.cpp databaseLockedKey) —
 # persisted so the lock survives recovery and rides the DR seed/stream.
 DB_LOCKED = b"\xff/dbLocked"

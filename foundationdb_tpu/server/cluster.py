@@ -51,7 +51,7 @@ class Cluster:
                  replication=None, commit_pipeline="sync",
                  commit_batch_max=None, commit_flush_after=4,
                  target_tps=None, rk_clock=None, n_tlogs=1, fsync=False,
-                 n_commit_proxies=1,
+                 n_commit_proxies=1, regions=None,
                  **knob_overrides):
         if knobs is None:
             knobs = (
@@ -82,6 +82,7 @@ class Cluster:
         self.ratekeeper = Ratekeeper(
             target_tps=target_tps if target_tps is not None else 1e9,
             clock=rk_clock,
+            tag_busy_threshold=knobs.tag_throttle_busyness,
         )
         if storage_engines is None:
             storage_engines = [None] * n_storage
@@ -244,9 +245,30 @@ class Cluster:
         self.clock_advance = None
         self.recovery_timeline = health_mod.RecoveryTimeline()
         self.prober = health_mod.LatencyProber(self)
+        # multi-region replication (server/region.py): None until a
+        # region config attaches; the frontend below reads it, so the
+        # attribute must exist before _build_txn_frontend
+        self.regions = None
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()
+        # region config: constructor argument wins; otherwise a
+        # recovered \xff/conf/regions row re-attaches replication (the
+        # config persists beside the replication factor — `configure
+        # regions=...` survives a full restart). Restored attaches
+        # re-seed the satellite from the recovered state; only a NEW
+        # config writes the system row.
+        region_cfg = regions
+        if region_cfg is None and recovered_records:
+            s0 = self.storages[0]
+            row = s0.get(systemdata.CONF_REGIONS, s0.version)
+            if row is not None:
+                region_cfg = row
+        if region_cfg is not None:
+            from foundationdb_tpu.server.region import RegionConfig
+
+            self._attach_regions(RegionConfig.parse(region_cfg),
+                                 persist=regions is not None)
         # only thread-mode clusters get the background probe loop; sims
         # and sync deployments drive maybe_probe() from their own
         # schedule so determinism is never perturbed
@@ -344,6 +366,7 @@ class Cluster:
             self.knobs, self.ratekeeper, dd=self.dd,
             change_feeds=self.change_feeds,
             resolve_gate=resolve_gate, log_gate=log_gate,
+            regions=getattr(self, "regions", None),
             metrics=self._role_registry("commit_proxy", index),
             heatmap=(
                 self._role_heatmap("commit_proxy", index,
@@ -455,6 +478,29 @@ class Cluster:
         """One failure-monitor round; returns [(role, index), ...] of
         recruitments performed."""
         events = []
+        # whole-primary-region loss comes FIRST: with the sequencer,
+        # proxies, storages, and log tier all dead, the ordinary
+        # txn-system recovery below cannot even read a log frontier
+        # (TLogDown) — the remote region's satellite log is the only
+        # surviving durable state, and promotion replaces every primary
+        # role in one recovery (ref: ClusterRecovery choosing a remote
+        # region when the primary's logs are unrecoverable). A
+        # coordination failure mid-failover leaves the roles dead and
+        # the NEXT monitor round retries.
+        reg = self.regions
+        if reg is not None and reg.should_failover(self):
+            with self._recovery_mu:
+                if reg.should_failover(self):
+                    try:
+                        self._region_failover()
+                    except CoordinatorDown as e:
+                        reg.note_failed_attempt(e)
+                        return events
+                    events.append(("region-failover", 0))
+                    self.recruitments += 1
+                    TraceEvent("RolesRecruited").detail(
+                        events=events).log()
+                    return events
         if not self.sequencer.alive or not self._commit_target().alive:
             # a dead sequencer or commit proxy forces a transaction-
             # system recovery: new generation through the coordination
@@ -593,6 +639,148 @@ class Cluster:
             generation=gen, version=recovered, trigger=trigger,
             recovery_ms=rec.record["total_ms"]).log()
 
+    def _storage_owns(self, smap, sid, m):
+        """Does storage ``sid`` own mutation ``m`` under shard map
+        ``smap``? (None = full replication: everyone owns everything;
+        the system keyspace replicates everywhere regardless.) Shared
+        by storage recruitment and region-failover replay."""
+        from foundationdb_tpu.core.mutations import Op
+
+        if smap is None:
+            return True
+        if m.key >= b"\xff":
+            return True  # system keyspace replicates everywhere
+        if m.op == Op.CLEAR_RANGE:
+            return any(
+                sid in smap.teams[i]
+                for i in smap.shards_overlapping(m.key, m.param)
+            )
+        return sid in smap.team_for(m.key)
+
+    def _region_failover(self):
+        """Promote the remote region after whole-primary-region loss
+        (ref: ClusterRecovery recruiting from a remote region when the
+        primary's logs are unrecoverable). The shape is the ordinary
+        ``_recover_txn_system`` state machine — same phases, same
+        generation CAS, same timeline recorder (trigger
+        ``region_failover``) — with two substitutions: the SATELLITE
+        log is promoted to be THE log (its frontier, not the dead
+        primary tier's, bounds what survives: every acked commit in
+        sync satellite mode, acked-minus-measured-lag in async), and
+        the storage fleet is rebuilt fresh in the remote region by
+        replaying the promoted log from its seed snapshot. Caller holds
+        ``_recovery_mu``."""
+        import contextlib
+
+        reg = self.regions
+        rec = self.recovery_timeline.begin("region_failover",
+                                           self.clock_advance)
+        old_proxy = self.commit_proxy
+        old_inners = self._inner_proxies()
+        old_grv = self.grv_proxy
+        old_storages = list(self.storages)
+        # quiesce (same discipline as _recover_txn_system: dead roles
+        # answer 1021 at entry, in-flight batches finish under the old
+        # generation before we read the replication frontier)
+        for p in old_inners:
+            p.kill()
+        self.sequencer.kill()
+        with contextlib.ExitStack() as stack:
+            for p in old_inners:
+                stack.enter_context(p._commit_mu)
+            frontier = reg.position
+        rec.phase("fence")
+        # the CAS can raise CoordinatorDown: nothing has been promoted
+        # yet, every role is still dead, and the caller counts a failed
+        # attempt — the next monitor round retries the whole failover
+        gen = self.generation = self._win_generation(frontier)
+        rec.phase("cas")
+        # the satellite log becomes THE log: full history from the seed
+        # snapshot onward, and future commits append to it (after a
+        # full process restart the satellite WAL is the durable log)
+        self.tlog = reg.promote_log()
+        self.sequencer = Sequencer(
+            version_clock=self.sequencer.version_clock,
+            start_version=frontier,
+        )
+        # resolvers fence at the frontier exactly like any recovery:
+        # pre-disaster read versions retry TOO_OLD
+        for i, r in enumerate(self.resolvers):
+            self.resolvers[i] = r.respawn(frontier)
+        self._attach_device_profiles()
+        # fresh storage fleet in the remote region. The primary fleet's
+        # engines are LOST with the region (reusing one could carry
+        # durable state past the replication frontier); replacements
+        # start empty, inherit the cluster-owned metrics/heat so
+        # counters never rewind, and swap in place — the dd/router/
+        # proxy lists are shared. Fleet shape is unchanged, so the
+        # replicated shard map stays valid as-is.
+        fresh = []
+        for sid, old in enumerate(old_storages):
+            new = StorageServer(
+                window_versions=(
+                    self.knobs.max_read_transaction_life_versions),
+            )
+            new.region = reg.config.remote
+            new.adopt_metrics(old.metrics)
+            if self.knobs.workload_sampling:
+                new.attach_heatmaps(
+                    self._role_heatmap("storage_read", sid),
+                    self._role_heatmap("storage_write", sid),
+                    self.knobs.storage_sample_every,
+                )
+            fresh.append(new)
+        self.storages[:] = fresh
+        for log in (self.tlog.logs if isinstance(self.tlog, TLogSystem)
+                    else [self.tlog]):
+            log.region = reg.config.remote
+        rec.phase("recruit")
+        # replay the promoted log from the beginning — record one is
+        # the seed snapshot — with the same ownership filter storage
+        # recruitment uses, so placement survives the region flip
+        smap = self.dd.map if self.replication < len(self.storages) \
+            else None
+        for sid, new in enumerate(self.storages):
+            for version, muts in self.tlog.peek(0):
+                if version > new.version:
+                    new.apply(
+                        version,
+                        [m for m in muts
+                         if self._storage_owns(smap, sid, m)],
+                    )
+        self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
+        self._commit_target().update_resolver_ranges(fence=False)
+        # lock/tenant/quota enforcement re-derives from the replayed
+        # system keyspace (the seed + stream carried the rows)
+        self._restore_tenant_config()
+        rec.phase("replay")
+        if self.commit_pipeline != "sync":
+            old_proxy.fail_pending(err("commit_unknown_result"))
+        old_proxy.close()
+        if hasattr(old_grv, "close"):
+            old_grv.close()
+        for old in old_storages:
+            try:
+                old.engine.close()
+            except Exception as e:
+                # a lost region's engine may be gone already, but say so:
+                # repeated close failures here would mean leaked redwood
+                # files, which the trace is the only way to spot
+                TraceEvent("RegionFailoverEngineClose", severity=40).detail(
+                    etype=type(e).__name__, error=str(e)[:200]).log()
+        # watches parked on dead primary storages wake so clients
+        # re-read and re-register against the promoted fleet
+        for old in old_storages:
+            for key in list(old._watches):
+                for w in old._watches.pop(key):
+                    w._fire()
+        rec.phase("accept")
+        rec.finish(gen, frontier)
+        reg.note_failover(rec.record["total_ms"])
+        TraceEvent("TxnSystemRecovered").detail(
+            generation=gen, version=frontier, trigger="region_failover",
+            recovery_ms=rec.record["total_ms"]).log()
+
     def _recruit_storage(self, sid):
         """Replace a dead storage by rebooting onto its durable engine
         and replaying the log from there (ref: a storage process
@@ -617,22 +805,12 @@ class Cluster:
                 self.knobs.storage_sample_every,
             )
         smap = self.dd.map if self.replication < len(self.storages) else None
-        from foundationdb_tpu.core.mutations import Op
-
-        def owned(m):
-            if smap is None:
-                return True
-            if m.key >= b"\xff":
-                return True  # system keyspace replicates everywhere
-            if m.op == Op.CLEAR_RANGE:
-                return any(
-                    sid in smap.teams[i]
-                    for i in smap.shards_overlapping(m.key, m.param)
-                )
-            return sid in smap.team_for(m.key)
-
         for version, muts in self.tlog.peek(new.version):
-            new.apply(version, [m for m in muts if owned(m)])
+            new.apply(
+                version,
+                [m for m in muts if self._storage_owns(smap, sid, m)],
+            )
+        new.region = getattr(old, "region", None)  # placement tag carries
         self.storages[sid] = new  # lists are shared: router/proxy/dd see it
         # watches parked on the dead instance wake so clients re-read and
         # re-register against the replacement
@@ -644,6 +822,8 @@ class Cluster:
         """Release background machinery (batcher threads, thread pools)
         and durable handles."""
         self.prober.stop()
+        if self.regions is not None:
+            self.regions.close()
         if hasattr(self.grv_proxy, "close"):
             self.grv_proxy.close()
         if hasattr(self.commit_proxy, "close"):
@@ -794,21 +974,35 @@ class Cluster:
     def resolver_lanes(self):
         return sum(getattr(r, "n_lanes", 1) for r in self.resolvers)
 
-    def configure(self, commit_proxies=None, resolvers=None):
+    def configure(self, commit_proxies=None, resolvers=None,
+                  regions=None):
         """Live reconfiguration (ref: fdbcli `configure proxies=N
-        resolvers=N` → ManagementAPI changeConfig forcing a recovery):
-        resizing the commit-proxy fleet or the resolver fleet rides the
-        ordinary txn-system recovery — a new generation with the new
-        sizes over the same storage and logs; in-flight clients ride it
-        out on retryable errors. New resolvers open FENCED at the
-        committed version (their empty conflict history cannot check
-        older read versions), exactly like recovery's respawn."""
+        resolvers=N regions=<json>` → ManagementAPI changeConfig
+        forcing a recovery): resizing the commit-proxy fleet, the
+        resolver fleet, or the region configuration rides the ordinary
+        txn-system recovery — a new generation with the new shape over
+        the same storage and logs; in-flight clients ride it out on
+        retryable errors. New resolvers open FENCED at the committed
+        version (their empty conflict history cannot check older read
+        versions), exactly like recovery's respawn. ``regions`` takes a
+        RegionConfig / dict / JSON string (validated BEFORE the fencing
+        recovery — a typo must not bounce the txn system), or
+        ``"off"``/``{}`` to detach replication; the satellite attaches
+        AFTER the recovery, against the fresh frontend, and the config
+        persists in the \\xff/conf/regions system row."""
+        from foundationdb_tpu.server.region import RegionConfig
+
         for v in (commit_proxies, resolvers):
             if v is not None and int(v) < 1:
                 raise err("invalid_option_value")
+        region_off = regions in ("off", b"off", "", {})
+        new_region_cfg = None
+        if regions is not None and not region_off:
+            new_region_cfg = RegionConfig.parse(regions)
         with self._recovery_mu:
             changed = False
             lanes = None
+            region_change = False
             if (commit_proxies is not None
                     and int(commit_proxies) != self.n_commit_proxies):
                 self.n_commit_proxies = int(commit_proxies)
@@ -825,11 +1019,112 @@ class Cluster:
                     lanes = int(resolvers)
                     self._requested_resolver_lanes = lanes
                     changed = True
+            if regions is not None:
+                # same no-op discipline as the resolver compare: a
+                # management loop re-applying its desired region config
+                # must not re-seed the satellite every pass
+                if region_off:
+                    region_change = self.regions is not None
+                else:
+                    region_change = (
+                        self.regions is None
+                        or self.regions.config != new_region_cfg
+                    )
+                changed = changed or region_change
             if changed:
                 self._recover_txn_system(new_resolver_lanes=lanes,
                                          trigger="configure")
-        return {"commit_proxies": self.n_commit_proxies,
-                "resolver_lanes": self.resolver_lanes()}
+            if region_change:
+                if new_region_cfg is None:
+                    self._detach_regions()
+                else:
+                    self._attach_regions(new_region_cfg, persist=True)
+        shape = {"commit_proxies": self.n_commit_proxies,
+                 "resolver_lanes": self.resolver_lanes()}
+        # only a region-touching configure reports the region shape, so
+        # proxy/resolver resizes keep their seed-era return contract
+        if regions is not None:
+            shape["regions"] = (self.regions.config.to_json()
+                                if self.regions is not None else None)
+        return shape
+
+    def _attach_regions(self, config, persist=True):
+        """Install the RegionReplicator for ``config``: satellite log
+        at ``<wal_path>.satellite`` (in-memory when the cluster is),
+        region tags stamped on the primary's tlog replicas and
+        storages, the live proxies handed the replicator for sync-mode
+        commit gating, and — in thread pipelines — the continuous
+        streamer started. ``persist`` writes the \\xff/conf/regions
+        system row (False on restart-restore: the row is already
+        durable)."""
+        from foundationdb_tpu.server.region import RegionReplicator
+
+        if self.regions is not None:
+            self.regions.drop()
+            self.regions.close()
+        wal = getattr(self.tlog, "wal_path", None)
+        self.regions = RegionReplicator(
+            self, config,
+            wal_path=f"{wal}.satellite" if wal else None,
+        )
+        # region-tagged placement: every primary role carries the
+        # primary region id (the replicator stamped its satellite
+        # replicas with the remote id); recruitment carries the tags to
+        # replacements
+        for s in self.storages:
+            s.region = config.primary
+        for log in (self.tlog.logs if isinstance(self.tlog, TLogSystem)
+                    else [self.tlog]):
+            log.region = config.primary
+        for p in self._inner_proxies():
+            p.regions = self.regions
+        if persist:
+            self._persist_region_config()
+        if self.commit_pipeline == "thread":
+            self.regions.start()
+        return self.regions
+
+    def _detach_regions(self):
+        """``configure regions=off``: release the primary-log pin, stop
+        the streamer, close the satellite, clear the placement tags,
+        and clear the persisted system row."""
+        reg, self.regions = self.regions, None
+        if reg is not None:
+            reg.drop()
+            reg.close()
+        for s in self.storages:
+            s.region = None
+        for log in (self.tlog.logs if isinstance(self.tlog, TLogSystem)
+                    else [self.tlog]):
+            log.region = None
+        for p in self._inner_proxies():
+            p.regions = None
+        self._persist_region_config()
+
+    def _persist_region_config(self):
+        """Write (or clear) the \\xff/conf/regions row through the
+        normal commit pipeline — tlog-durable, restored by WAL recovery
+        like the shard map, and streamed to the satellite so a promoted
+        region knows its own region config. Best-effort like
+        persist_shard_map."""
+        from foundationdb_tpu.core import systemdata
+        from foundationdb_tpu.core.mutations import Mutation, Op
+        from foundationdb_tpu.server.proxy import CommitRequest
+
+        if self.regions is not None:
+            muts = [Mutation(
+                Op.SET, systemdata.CONF_REGIONS,
+                self.regions.config.to_json().encode(),
+            )]
+        else:
+            muts = [Mutation(Op.CLEAR, systemdata.CONF_REGIONS)]
+        req = CommitRequest(
+            read_version=self.sequencer.committed_version,
+            mutations=muts, read_conflict_ranges=[],
+            write_conflict_ranges=[],
+        )
+        result = self.commit_proxy.commit(req)
+        return not isinstance(result, Exception)
 
     def lock_database(self, uid=b"lock"):
         """Ref: ManagementAPI lockDatabase — commits from transactions
@@ -1105,6 +1400,10 @@ class Cluster:
                         row[field] = row.get(field, 0) + v
         for tag, busy in self.ratekeeper.tag_busyness.items():
             out.setdefault(tag, {})["busyness"] = busy
+        # live admission limits (AIMD + standalone busyness throttle +
+        # operator quotas): what GRV is actually enforcing per tag
+        for tag, tps in self.ratekeeper.throttled_tags().items():
+            out.setdefault(tag, {})["limit_tps"] = round(tps, 2)
         return {t: out[t] for t in sorted(out)}
 
     def hot_ranges_status(self, top=None):
@@ -1214,6 +1513,12 @@ class Cluster:
                 },
                 "database_available": live_storages > 0,
                 "database_lock_state": _lock_state(self.lock_uid()),
+                # multi-region replication (server/region.py): config +
+                # live replication state, always present so operators
+                # and tools never branch on a missing key
+                "regions": (self.regions.status()
+                            if self.regions is not None
+                            else {"configured": False}),
                 "metacluster": self._metacluster_status(),
                 "change_feeds": len(self.change_feeds),
                 "degraded": degraded,

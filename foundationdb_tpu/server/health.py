@@ -276,6 +276,13 @@ _MESSAGES = {
                           "(target TPS squeezed below capacity).",
     "probe_failures": "The most recent latency probe failed; the "
                       "transaction path may be impaired.",
+    "region_lag": "Remote-region replication lag exceeds the doctor "
+                  "threshold; a failover now would lose that much.",
+    "region_replication_broken": "Region replication lost log "
+                                 "continuity; the satellite must be "
+                                 "re-seeded before it can fail over.",
+    "satellite_down": "The satellite region is unreachable (WAN "
+                      "partition); replication lag is growing.",
 }
 
 
@@ -355,6 +362,22 @@ def build_health(cluster):
         degraded.add("storage_lag")
     if saturation >= 0.5:
         degraded.add("workload_saturated")
+    # ── multi-region replication (server/region.py) ──
+    # always-present section: tools never branch on a missing key. The
+    # broken/partition split matters to an operator — broken needs a
+    # re-seed, a partition just needs the WAN back (elif: broken
+    # subsumes the connectivity complaint).
+    reg = getattr(cluster, "regions", None)
+    regions_doc = reg.status() if reg is not None else {
+        "configured": False}
+    if reg is not None and reg.replicating:
+        if reg.broken:
+            degraded.add("region_replication_broken")
+        elif reg.partitioned:
+            degraded.add("satellite_down")
+        if (regions_doc["replication_lag_versions"]
+                > knobs.doctor_region_lag_versions):
+            degraded.add("region_lag")
     prober = getattr(cluster, "prober", None)
     probe_doc = prober.status() if prober is not None else {
         "enabled": False, "probes": 0, "failures": 0, "last_error": None,
@@ -396,4 +419,5 @@ def build_health(cluster):
             "grv_queue_depth": grv_depth,
         },
         "ratekeeper": rk_doc,
+        "regions": regions_doc,
     }

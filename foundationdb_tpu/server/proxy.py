@@ -101,8 +101,14 @@ class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None,
                  resolve_gate=None, log_gate=None, metrics=None,
-                 heatmap=None):
+                 heatmap=None, regions=None):
         self.alive = True
+        # multi-region replication (server/region.py RegionReplicator):
+        # in sync satellite mode the finalize tail pushes each batch to
+        # the remote region BEFORE acknowledging it. The cluster swaps
+        # this attribute when regions are (de)configured — read fresh
+        # per batch, never cached.
+        self.regions = regions
         # per-role metrics (ref: Stats.h CounterCollection on the commit
         # proxy). The cluster hands recovery incarnations the SAME
         # registry, so counters survive recruitment without rewinding;
@@ -1229,6 +1235,16 @@ class CommitProxy:
                 for r in results
             ]
         self._m_committed.inc(n_ok)  # monotone: counted only once durable
+        # sync satellite mode: the batch reaches the remote region's
+        # log before any client sees the ack, so a primary-region
+        # disaster after this point loses nothing (ref: satellite TLogs
+        # in the commit path). sync_push degrades to a counted miss —
+        # never a stall — when the WAN is partitioned or the satellite
+        # is down; async mode skips this entirely (the streamer drains
+        # on its own cadence and the lag is the measured exposure).
+        if (self.regions is not None
+                and self.regions.config.satellite_mode == "sync"):
+            self.regions.sync_push(cv, batch_mutations)
         for sid, muts in enumerate(routed):
             if not self.storages[sid].alive:
                 # a detected-dead storage misses the batch; recruitment

@@ -37,10 +37,18 @@ class Ratekeeper:
     TAG_BUSY_FRACTION = 0.5  # share of admissions that reads as "busy"
     TAG_RELEASE_FACTOR = 1.5  # limit regrowth per healthy control round
 
-    def __init__(self, target_tps=1e9, batch_priority_fraction=0.5, clock=None):
+    def __init__(self, target_tps=1e9, batch_priority_fraction=0.5,
+                 clock=None, tag_busy_threshold=1.0):
         self.max_tps = target_tps
         self.target_tps = target_tps
         self.batch_priority_fraction = batch_priority_fraction
+        # standalone busy-tag policy (knob tag_throttle_busyness, ref:
+        # TagThrottler auto-throttling a busy tag without waiting for
+        # global pressure): a tag whose admission share exceeds this
+        # threshold gets its own limit even while the cluster budget is
+        # healthy. 1.0 = off (a share can never exceed 1.0); the
+        # under-pressure AIMD path below is always on.
+        self.tag_busy_threshold = float(tag_busy_threshold)
         # Injectable clock so the deterministic simulation can drive the
         # token bucket off its step counter instead of wall time (admission
         # results must replay byte-identically under a seed).
@@ -261,7 +269,14 @@ class Ratekeeper:
         its observed rate (multiplicative decrease); healthy rounds
         regrow the limit until it clears the tag's demand, then release
         it. Manual quotas (tag_quotas) are operator-sticky and never
-        auto-released."""
+        auto-released.
+
+        The STANDALONE policy (tag_busy_threshold < 1.0) additionally
+        throttles a tag whose admission share exceeds the threshold
+        even WITHOUT global pressure — and holds the limit (no regrow)
+        while the tag stays over-threshold, so one abusive workload is
+        capped the moment it dominates admissions rather than only
+        after it saturates the cluster."""
         now = self.clock()
         elapsed = max(now - self._tag_window_start, 1e-9)
         total = self._recent_admits
@@ -284,8 +299,14 @@ class Ratekeeper:
                 and total > 0
                 and cnt / total > self.TAG_BUSY_FRACTION
             )
+            standalone = (
+                self.tag_busy_threshold < 1.0
+                and cnt >= self.TAG_SAMPLE_MIN
+                and total > 0
+                and cnt / total > self.tag_busy_threshold
+            )
             limit = self.tag_limits.get(tag)
-            if under_pressure and busy:
+            if (under_pressure and busy) or standalone:
                 new_limit = max(rate / 2, 1.0)
                 self.tag_limits[tag] = (
                     min(limit, new_limit) if limit is not None else new_limit

@@ -140,6 +140,11 @@ class StorageServer(RangeReadInterface):
         # Single-threaded deployments pay one uncontended acquire per op.
         self._mu = lockdep.rlock("StorageServer._mu")
         self.alive = True  # failure detection flips this (sim kill)
+        # placement tag (ref: storage locality in DatabaseConfiguration
+        # region blocks): the cluster stamps its primary-region id when
+        # regions are configured, and recruitment carries it to
+        # replacements. None = regions not configured.
+        self.region = None
         self.engine = engine if engine is not None else KeyValueStoreMemory()
         # Versioned engines (the Redwood role, kvstore.KeyValueStoreVersioned)
         # store per-key version chains, so the MVCC window extends into the
@@ -630,5 +635,9 @@ class StorageServer(RangeReadInterface):
         self.metrics.gauge("durability_lag_versions").set(
             max(0, self.version - self.durable_version)
         )
-        return {"alive": self.alive, "metrics": self.metrics.snapshot()}
+        return {
+            "alive": self.alive,
+            "region": self.region,
+            "metrics": self.metrics.snapshot(),
+        }
 

@@ -44,6 +44,12 @@ class TLog:
         self._tags = {}  # version -> {tag: [mutations]} (memory only)
         self._first_version = 0
         self.index = 0  # replica id (TLogSystem numbers its members)
+        # placement tag (ref: the region/locality of a TLog recruit in
+        # DatabaseConfiguration region blocks): the cluster stamps its
+        # primary-region id here, the RegionReplicator stamps its
+        # satellite replicas with the remote region id. None = regions
+        # not configured.
+        self.region = None
         self.wal_path = wal_path
         self.fsync = fsync
         self.alive = True
@@ -193,7 +199,11 @@ class TLog:
         """This replica's status RPC payload (leaf of the status doc)."""
         self.metrics.gauge("retained_records").set(len(self._log))
         self.metrics.gauge("last_version").set(self.last_version)
-        return {"alive": self.alive, "metrics": self.metrics.snapshot()}
+        return {
+            "alive": self.alive,
+            "region": self.region,
+            "metrics": self.metrics.snapshot(),
+        }
 
     def close(self):
         self.alive = False

@@ -223,6 +223,10 @@ class Simulation:
                 err("commit_unknown_result")
             )
         self.cluster.commit_proxy.close()
+        if self.cluster.regions is not None:
+            # the satellite WAL handle must flush before the rebuilt
+            # cluster's restored region config truncates and re-seeds it
+            self.cluster.regions.close()
         for s in self.cluster.storages:
             s.engine.close()
         self.cluster.tlog.close()
@@ -265,6 +269,14 @@ class Simulation:
             # (deterministic analog of the proxy's commit interval)
             if self._pump is not None:
                 self._pump(self.steps)
+            # continuous region streamer: the sim scheduler drives the
+            # satellite drain exactly where a thread deployment's
+            # daemon loop would — cadence off the injected clock + the
+            # "region-stream" deterministic stream, so same-seed runs
+            # replicate at the same steps
+            reg = self.cluster.regions
+            if reg is not None:
+                reg.maybe_stream()
         self._actors = []
 
     # steps between failure-monitor rounds: kills stay undetected for a
@@ -325,7 +337,8 @@ class Simulation:
                 self.role_kills += 1
         if self.steps % self.MONITOR_EVERY == 0:
             events = c.detect_and_recruit()
-            if any(role == "txn-system" for role, _ in events):
+            if any(role in ("txn-system", "region-failover")
+                   for role, _ in events):
                 # recovery recruited bare proxies: restore the sim's
                 # fault-injection wrappers around the new incarnation
                 # (and re-cache the manual-mode pump — the old one
@@ -412,6 +425,40 @@ class Simulation:
             machine=mid, storages=storages, tlogs=tlogs,
             resolvers=resolvers, txn_system=txn_system).log()
 
+    def kill_primary_region(self):
+        """Regional disaster: every primary-region process dies in ONE
+        event — the whole storage fleet, every tlog replica, the
+        resolvers, and the txn system (ref: sim2 killing an entire
+        datacenter). Deliberately ignores the killability protection
+        sets: a region loss IS the unrecoverable-locally scenario. The
+        failure monitor's next round detects whole-region loss and
+        promotes the remote region (Cluster._region_failover); without
+        a region config the cluster simply stays down."""
+        c = self.cluster
+        for s in c.storages:
+            if s.alive:
+                s.kill()
+        if isinstance(c.tlog, TLogSystem):
+            for i, log in enumerate(c.tlog.logs):
+                if log.alive:
+                    c.tlog.kill(i)
+        else:
+            c.tlog.kill()
+        for r in c.resolvers:
+            if r.alive:
+                r.kill()
+        if c.sequencer.alive:
+            c.sequencer.kill()
+        target = c._commit_target()
+        if target.alive:
+            target.kill()
+        if self.net.pending:
+            self.net.partition(self.rng.randint(3, 12))
+        TraceEvent("SimRegionKill", severity=30).detail(
+            step=self.steps,
+            region=(c.regions.config.primary
+                    if c.regions is not None else None)).log()
+
     def _maybe_reboot_machine(self):
         if not self.buggify("machine_reboot", fire_p=0.0015):
             return
@@ -452,6 +499,8 @@ class Simulation:
         """Close WAL/engine handles (the datadir itself is left for
         inspection; callers own its lifetime)."""
         self.cluster.commit_proxy.close()
+        if self.cluster.regions is not None:
+            self.cluster.regions.close()
         for s in self.cluster.storages:
             s.engine.close()
         self.cluster.tlog.close()

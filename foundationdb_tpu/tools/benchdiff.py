@@ -43,6 +43,11 @@ LOWER_BETTER = (
     # and durability lag are all regressions
     "recovery_count", "probe_failures", "admit_denied", "queue_depth",
     "lag_versions",
+    # multi-region replication: replication_lag_ms already resolves via
+    # "_ms", but the version-denominated lag and any growth in failover
+    # count or failover duration are regressions too ("failover" covers
+    # region_failovers and last_failover_ms alike)
+    "replication_lag", "failover",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",

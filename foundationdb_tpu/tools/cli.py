@@ -143,6 +143,7 @@ class Cli:
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
+            "  configure regions=JSON|off      multi-region replication",
             "  exclude [ID]                    drain a storage (list with no arg)",
             "  include ID                      cancel an exclusion",
             "  option ...                      accepted, no-op",
@@ -244,6 +245,23 @@ class Cli:
             f"(backend: {c['resolver_backend']})",
             f"  Storage servers     - {c['storage_servers']}",
             f"  Shards              - {c.get('data', {}).get('shards', 1)}",
+        )
+        # multi-region replication (only when configured: an
+        # unconfigured cluster's status output stays unchanged)
+        reg = c.get("regions") or {}
+        if reg.get("configured"):
+            self._p(
+                f"  Regions             - {reg['primary']} -> "
+                f"{reg['remote']} ({reg['satellite_mode']}, "
+                f"{reg['satellites']} satellite"
+                f"{'s' if reg['satellites'] != 1 else ''}, "
+                f"active: {reg['active']})",
+                f"  Replication lag     - "
+                f"{reg['replication_lag_versions']} versions / "
+                f"{reg['replication_lag_ms']} ms"
+                + ("" if reg["connected"] else "  [DISCONNECTED]"),
+            )
+        self._p(
             "Workload:",
             f"  Started             - {w['started']['counter']}",
             f"  Committed           - {w['committed']['counter']}",
@@ -335,8 +353,11 @@ class Cli:
 
     def _cmd_configure(self, args):
         """Ref: fdbcli `configure` → changeConfig. Supported:
-        commit_proxies=N (a txn-system recovery installs the new fleet
-        size over the same storage and logs)."""
+        commit_proxies=N / resolvers=N (a txn-system recovery installs
+        the new fleet size over the same storage and logs) and
+        regions=<json>|off (multi-region replication: the JSON names
+        primary/remote region ids, satellite count, and sync|async
+        satellite mode — see server/region.py RegionConfig)."""
         kw = {}
         for a in args:
             k, _, v = a.partition("=")
@@ -344,6 +365,11 @@ class Cli:
                 kw["commit_proxies"] = int(v)
             elif k == "resolvers" and v:
                 kw["resolvers"] = int(v)
+            elif k == "regions" and v:
+                # "off" detaches; anything else must be the region
+                # JSON — validation (and the typo errors) belong to
+                # RegionConfig.parse, not the shell
+                kw["regions"] = v
             else:
                 self._p(f"ERROR: unsupported configure option `{a}'")
                 return
@@ -588,7 +614,7 @@ class Cli:
                 fields = ", ".join(
                     f"{f}={row[f]}" for f in
                     ("started", "committed", "conflicted", "too_old",
-                     "busyness") if f in row
+                     "busyness", "limit_tps") if f in row
                 )
                 self._p(f"  {tag}: {fields}")
 

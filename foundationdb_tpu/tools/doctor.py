@@ -27,6 +27,8 @@ DEFAULT_THRESHOLDS = {
     "probe_p99_ms": DEFAULT_KNOBS.doctor_probe_p99_ms,
     "recovery_ms": DEFAULT_KNOBS.doctor_recovery_ms,
     "lag_versions": DEFAULT_KNOBS.doctor_lag_versions,
+    "region_lag_versions": DEFAULT_KNOBS.doctor_region_lag_versions,
+    "failover_ms": DEFAULT_KNOBS.doctor_region_failover_ms,
 }
 
 
@@ -70,6 +72,27 @@ def check(health, thresholds=None):
             f"slo: storage durability lag {lag} versions exceeds "
             f"{th['lag_versions']}"
         )
+    # region SLOs: only meaningful while replication is configured —
+    # an unconfigured cluster must never alert on region state
+    regions = health.get("regions") or {}
+    if regions.get("configured"):
+        rlag = regions.get("replication_lag_versions", 0) or 0
+        if rlag > th["region_lag_versions"]:
+            alerts.append(
+                f"slo: region replication lag {rlag} versions exceeds "
+                f"{th['region_lag_versions']}"
+            )
+        if not regions.get("connected", True):
+            alerts.append(
+                "slo: satellite region disconnected "
+                f"(broken={regions.get('broken', False)})"
+            )
+        fo_ms = regions.get("last_failover_ms", 0) or 0
+        if fo_ms > th["failover_ms"]:
+            alerts.append(
+                f"slo: last region failover took {fo_ms}ms, over "
+                f"{th['failover_ms']}ms"
+            )
     return alerts, verdict
 
 
@@ -125,12 +148,16 @@ def main(argv=None, out=None, sleep=time.sleep):
     ap.add_argument("--probe-p99-ms", type=float, default=None)
     ap.add_argument("--recovery-ms", type=float, default=None)
     ap.add_argument("--lag-versions", type=int, default=None)
+    ap.add_argument("--region-lag-versions", type=int, default=None)
+    ap.add_argument("--failover-ms", type=float, default=None)
     ap.add_argument("--json", action="store_true", dest="as_json")
     ns = ap.parse_args(argv)
     thresholds = {
         "probe_p99_ms": ns.probe_p99_ms,
         "recovery_ms": ns.recovery_ms,
         "lag_versions": ns.lag_versions,
+        "region_lag_versions": ns.region_lag_versions,
+        "failover_ms": ns.failover_ms,
     }
 
     remote = None

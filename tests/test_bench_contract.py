@@ -49,6 +49,9 @@ def test_compact_summary_is_small_and_headline_last():
         "probe_grv_p99_ms": 0.06, "probe_commit_p99_ms": 9.8,
         "recovery_count": 1, "last_recovery_ms": 12.5,
         "health_verdict": "healthy",
+        # multi-region replication (ISSUE 14)
+        "region_mode": "sync", "replication_lag_ms": 0.0,
+        "region_failovers": 0,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -107,6 +110,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["recovery_count"] == 1
     assert line["last_recovery_ms"] == 12.5
     assert line["health_verdict"] == "healthy"
+    # the region gauges ride the summary — including the zero failover
+    # count, whose absence would be ambiguous
+    assert line["region_mode"] == "sync"
+    assert line["replication_lag_ms"] == 0.0
+    assert line["region_failovers"] == 0
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -198,8 +206,16 @@ def test_e2e_line_folds_proxies_and_platform():
                 # probe bands, recovery timeline, and health verdict
                 "probe_grv_p99_ms", "probe_commit_p99_ms",
                 "recovery_count", "last_recovery_ms",
-                "health_verdict"):
+                "health_verdict",
+                # multi-region replication (ISSUE 14): every line says
+                # whether a satellite region rode along and what it cost
+                "region_mode", "replication_lag_ms",
+                "region_failovers"):
         assert key in fields, key
+    # regions default OFF: the gauges must say so explicitly
+    assert fields["region_mode"] == "off"
+    assert fields["replication_lag_ms"] == 0.0
+    assert fields["region_failovers"] == 0
     # no fault was injected and nothing recovered: the doctor must say
     # healthy with an empty recovery timeline
     assert fields["health_verdict"] == "healthy"
@@ -281,6 +297,29 @@ def test_health_smoke_contract():
     from foundationdb_tpu.server import health as health_mod
 
     assert health_mod.enabled()
+
+
+def test_region_smoke_contract():
+    """BENCH_MODE=region_smoke: the three-arm probe (regions off vs
+    sync vs async satellite mode) emits the overhead/budget fields plus
+    the async arm's measured replication lag. One short round checks
+    the contract; the bench run owns the statistically serious
+    comparison."""
+    out = bench.run_region_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "off_txns_per_sec",
+                "async_txns_per_sec", "sync_overhead_pct",
+                "async_overhead_pct", "overhead_budget_pct",
+                "within_budget", "replication_lag_ms", "region_mode",
+                "region_failovers", "health_verdict"):
+        assert key in out, key
+    assert out["metric"] == "e2e_region_smoke"
+    # sync replication is real per-batch work, so its budget is the
+    # stated 15%, not the 2% of the pure-observability smokes
+    assert out["overhead_budget_pct"] == 15.0
+    # the measured arm really ran in sync mode and never failed over
+    assert out["region_mode"] == "sync"
+    assert out["region_failovers"] == 0
+    assert out["value"] > 0
 
 
 def test_heatmap_smoke_contract():

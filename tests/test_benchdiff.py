@@ -79,6 +79,11 @@ def test_polarity_table():
     assert benchdiff.polarity("profile_overhead_pct") == -1
     assert benchdiff.polarity("staging_reuse_rate") == +1
     assert benchdiff.polarity("hot_range_buckets") == 0  # never flagged
+    # multi-region replication: lag and failovers only ever regress up
+    assert benchdiff.polarity("replication_lag_ms") == -1
+    assert benchdiff.polarity("replication_lag_versions") == -1
+    assert benchdiff.polarity("region_failovers") == -1
+    assert benchdiff.polarity("last_failover_ms") == -1
 
 
 def test_bare_bench_line_accepted(tmp_path):

@@ -569,3 +569,39 @@ def test_fl001_flags_raw_entropy_in_profiler_sampling():
                 self.dispatches += 1
     """)
     assert rules_of(findings) == ["FL001"]
+
+
+def test_fl001_flags_wall_clock_region_streamer_cadence():
+    """ISSUE 14 satellite: the continuous region streamer's cadence is
+    a clock+RNG seam. Arming the next-due stamp off time.time() with a
+    module-level random jitter would make same-seed sims stream at
+    divergent steps — FL001 must trip on both draws."""
+    findings = lint("server/region.py", """
+        import random
+        import time
+
+        def maybe_stream(self, interval):
+            now = time.time()
+            if now < self._next_due:
+                return 0
+            self._next_due = now + interval * (0.5 + random.random())
+            return self.stream_now()
+    """)
+    assert rules_of(findings) == ["FL001", "FL001"]
+
+
+def test_fl001_seamed_region_streamer_cadence_passes():
+    """The shipped shape: injected clock + the named "region-stream"
+    RNG stream — replayable cadence, de-aligned real fleets."""
+    findings = lint("server/region.py", """
+        from foundationdb_tpu.core import deterministic
+
+        def maybe_stream(self, interval):
+            now = deterministic.now()
+            if now < self._next_due:
+                return 0
+            jitter = deterministic.rng("region-stream").random()
+            self._next_due = now + interval * (0.5 + jitter)
+            return self.stream_now()
+    """)
+    assert findings == []

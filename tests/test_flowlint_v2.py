@@ -148,6 +148,27 @@ def test_fl006_tree_lockorder_is_declared_and_live():
         assert "." in a and "." in b, f"malformed lock id in {a} -> {b}"
 
 
+def test_fl006_region_replication_edges_declared():
+    """ISSUE 14: the multi-region subsystem added real lock nestings —
+    the sync commit path pushes to the satellite under
+    CommitProxy._commit_mu, and the streamer drains TLog state under
+    RegionReplicator._mu. Each edge must be declared (reviewed) in
+    lockorder.txt and its REVERSE must not be: one global order for the
+    commit→region→tlog chain, no ABBA window."""
+    with open(flowlint.default_lockorder_path(), encoding="utf-8") as f:
+        declared, _ = fl006_lockorder.load_lockorder(f.read())
+    for edge in [
+        ("CommitProxy._commit_mu", "RegionReplicator._mu"),
+        ("RegionReplicator._mu", "TLog._holds_mu"),
+        ("RegionReplicator._mu", "TLog._data_cond"),
+        ("RegionReplicator._mu", "TLogSystem._data_cond"),
+        ("Cluster._recovery_mu", "TLogSystem._data_cond"),
+    ]:
+        assert edge in declared, f"missing reviewed edge {edge}"
+        rev = (edge[1], edge[0])
+        assert rev not in declared, f"ABBA: reverse edge {rev} declared"
+
+
 # ───────────────────────────── FL007 ─────────────────────────────
 def test_fl007_flags_unlocked_write_from_two_threads():
     findings = lint("server/foo.py", """

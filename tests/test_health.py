@@ -259,6 +259,100 @@ class TestSurfaces:
         assert alerts == []
 
 
+# ──────────────────── multi-region replication ────────────────────────
+class TestRegionHealth:
+    REGIONS = {"primary": "east", "remote": "west",
+               "satellites": 1, "satellite_mode": "async"}
+
+    def test_region_section_rides_health_always(self):
+        # unconfigured: the key is present and explicit, never missing
+        c = make_cluster()
+        try:
+            h = c.health_status()
+            assert h["regions"] == {"configured": False}
+            assert h["verdict"] == "healthy"
+        finally:
+            c.close()
+        c = make_cluster(regions=dict(self.REGIONS))
+        try:
+            h = c.health_status()
+            reg = h["regions"]
+            assert reg["configured"] is True
+            assert reg["primary"] == "east" and reg["remote"] == "west"
+            assert reg["satellite_mode"] == "async"
+            assert "replication_lag_versions" in reg
+            assert "replication_lag_ms" in reg
+            assert reg["failovers"] == 0
+        finally:
+            c.close()
+
+    def test_satellite_partition_and_broken_degrade(self):
+        c = make_cluster(regions=dict(self.REGIONS))
+        try:
+            assert c.health_status()["verdict"] == "healthy"
+            c.regions.partition()
+            h = c.health_status()
+            assert h["verdict"] == "degraded"
+            assert "satellite_down" in h["reasons"]
+            assert h["regions"]["connected"] is False
+            c.regions.heal()
+            # a replication gap is the stronger condition: it subsumes
+            # the mere-disconnect reason
+            c.regions.broken = True
+            h = c.health_status()
+            assert "region_replication_broken" in h["reasons"]
+            assert "satellite_down" not in h["reasons"]
+        finally:
+            c.close()
+
+    def test_region_lag_degrades_over_knob(self):
+        c = make_cluster(regions=dict(self.REGIONS),
+                         doctor_region_lag_versions=0)
+        try:
+            db = c.database()
+            for i in range(5):
+                db[b"lag%d" % i] = b"x"
+            # async mode, nothing streamed yet: the satellite trails
+            assert c.regions.lag_versions() > 0
+            h = c.health_status()
+            assert h["verdict"] == "degraded"
+            assert "region_lag" in h["reasons"]
+            # draining the stream clears the verdict
+            c.regions.stream_now()
+            h = c.health_status()
+            assert h["verdict"] == "healthy"
+            assert h["regions"]["replication_lag_versions"] == 0
+        finally:
+            c.close()
+
+    def test_doctor_region_slo_alerts(self):
+        h = {
+            "verdict": "healthy", "reasons": [], "messages": [],
+            "probe": {"grv": {}, "commit": {}},
+            "recovery": {"count": 0, "last_recovery_ms": 0},
+            "lag": {"durability_lag_versions_max": 0},
+            "regions": {"configured": True, "connected": False,
+                        "broken": True,
+                        "replication_lag_versions": 5_000_000,
+                        "last_failover_ms": 90_000.0},
+        }
+        alerts, verdict = doctor.check(h)
+        assert verdict == "healthy"
+        assert any("region replication lag" in a for a in alerts)
+        assert any("satellite region disconnected" in a
+                   and "broken=True" in a for a in alerts)
+        assert any("region failover" in a for a in alerts)
+        # per-flag override tightens/loosens like the other SLOs
+        alerts, _ = doctor.check(h, {"region_lag_versions": 10_000_000,
+                                     "failover_ms": 100_000.0})
+        assert not any("replication lag" in a for a in alerts)
+        assert not any("failover" in a for a in alerts)
+        # unconfigured clusters NEVER alert on region state
+        h["regions"] = {"configured": False}
+        alerts, _ = doctor.check(h)
+        assert alerts == []
+
+
 # ─────────────────── same-seed sim determinism ────────────────────────
 def _run_chaos_sim(datadir):
     from foundationdb_tpu.sim.simulation import Simulation

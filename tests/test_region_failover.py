@@ -1,0 +1,346 @@
+"""Multi-region replication under chaos (ISSUE 14): a whole-primary-
+region kill mid-YCSB-load promotes the remote region through the
+ordinary recovery machinery — sync satellite mode loses ZERO acked
+transactions, async loses at most the measured replication lag; WAN
+partitions degrade (never stall) and heal; a coordination failure
+mid-failover retries on the next monitor round; and same-seed runs are
+byte-identical on both storage engines."""
+
+import json
+import random
+
+import pytest
+
+from foundationdb_tpu.core.errors import FDBError
+from foundationdb_tpu.server.coordination import CoordinatorDown
+from foundationdb_tpu.sim.simulation import Simulation
+
+from conftest import TEST_KNOBS
+
+REGIONS = {"primary": "east", "remote": "west", "satellites": 1}
+
+
+def _region_sim(seed, tmp_path, mode, engine="memory", tag="", **kw):
+    kw.setdefault("n_storage", 2)
+    kw.setdefault("n_tlogs", 3)
+    # crash_and_recover would recover the PRE-failover primary WAL (a
+    # full-process restart after promotion belongs to the satellite
+    # WAL, which close() leaves on disk) — whole-cluster crashes are a
+    # different scenario from regional loss, so they stay off here
+    kw.setdefault("crash_p", 0.0)
+    return Simulation(
+        seed=seed, engine=engine,
+        datadir=str(tmp_path / f"r{seed}{tag}-{mode}-{engine}"),
+        regions=dict(REGIONS, satellite_mode=mode),
+        region_stream_interval_s=0.005,
+        **{**TEST_KNOBS, **kw},
+    )
+
+
+def _load_actor(sim, acked, aid, rounds=120):
+    """YCSB-ish writer: one key per lap, records (key -> commit
+    version) for every commit the cluster ACKNOWLEDGED. Rides out the
+    dead-role window between a kill and the monitor's next round the
+    way a real client does (retryable errors, back off a lap)."""
+    c = sim.cluster
+    db = sim.db
+    rng = random.Random(7000 + aid)
+
+    def gen():
+        for i in range(rounds):
+            for _ in range(rng.randint(1, 3)):
+                yield
+            if not (c.sequencer.alive and c._commit_target().alive):
+                continue  # dead window: skip the lap, like a real agent
+            tr = db.create_transaction()
+            k = b"load%d-%04d" % (aid, i)
+            tr[k] = b"v%04d" % i
+            try:
+                tr.commit()
+                acked[k] = tr.get_committed_version()
+            except FDBError as e:
+                if not e.is_retryable:
+                    raise
+    return gen()
+
+
+def _kill_actor(sim, at_step=60):
+    def gen():
+        for _ in range(at_step):
+            yield
+        sim.kill_primary_region()
+        yield
+    return gen()
+
+
+@pytest.mark.parametrize("engine", ["memory", "redwood"])
+@pytest.mark.parametrize("mode", ["sync", "async"])
+def test_primary_region_kill_mid_load(tmp_path, mode, engine):
+    """The headline scenario: every primary process dies in one event
+    mid-load; the failure monitor detects whole-region loss and
+    promotes the satellite in place. Sync: every acked commit survives.
+    Async: exactly the commits past the replication frontier may be
+    lost — the measured lag IS the loss bound."""
+    sim = _region_sim(11, tmp_path, mode, engine)
+    try:
+        c = sim.cluster
+        db = sim.db
+        acked = {}
+        for a in range(3):
+            sim.add_workload(f"load{a}", _load_actor(sim, acked, a))
+        sim.add_workload("kill", _kill_actor(sim))
+        sim.run()
+        sim.quiesce()
+
+        reg = c.regions
+        st = reg.status()
+        assert reg.failovers == 1, st
+        assert st["active"] == "west"
+        # the transition rode the ordinary recovery machinery and was
+        # recorded under its own trigger
+        recs = c.recovery_timeline.snapshot()["records"]
+        fo = [r for r in recs if r["trigger"] == "region_failover"]
+        assert len(fo) == 1
+        assert fo[0]["total_ms"] > 0
+        assert st["last_failover_ms"] == fo[0]["total_ms"]
+        # bounded failover: the whole promotion fit inside the doctor's
+        # SLO budget (simulated milliseconds off the step clock)
+        assert st["last_failover_ms"] < 60_000.0
+
+        # loss accounting against the promotion frontier
+        frontier = reg.position
+        assert acked, "load never committed"
+        lost = {k: v for k, v in acked.items() if db[k] is None}
+        if mode == "sync":
+            assert lost == {}, f"sync mode lost acked commits: {lost}"
+        else:
+            # async: anything at or below the frontier MUST survive;
+            # the rest is the advertised lag-bounded loss
+            over = {k: v for k, v in lost.items() if v <= frontier}
+            assert over == {}, f"async lost commits below frontier: {over}"
+        # the load kept committing AFTER promotion (acked versions past
+        # the frontier that are present) or at minimum new writes work
+        db[b"post-failover"] = b"alive"
+        assert db[b"post-failover"] == b"alive"
+        assert c.consistency_check() == []
+        assert c.health_status()["verdict"] == "healthy"
+    finally:
+        sim.close()
+
+
+def test_wan_partition_grows_lag_then_heals_and_drains(tmp_path):
+    """Async mode: a WAN partition makes streaming a no-op (the primary
+    keeps committing, lag grows in versions AND ms), healing drains the
+    backlog from the pinned primary records, and a failover after the
+    drain loses nothing."""
+    sim = _region_sim(23, tmp_path, "async")
+    try:
+        c = sim.cluster
+        db = sim.db
+        reg = c.regions
+        for i in range(20):
+            db[b"pre%03d" % i] = b"x"
+        reg.stream_now()
+        assert reg.lag_versions() == 0
+        reg.partition()
+        for i in range(20):
+            db[b"cut%03d" % i] = b"y"
+        assert reg.stream_now() == 0  # WAN down: drain is a no-op
+        assert reg.lag_versions() > 0
+        st = reg.status()
+        assert st["connected"] is False
+        assert st["replication_lag_ms"] >= 0.0
+        assert "satellite_down" in c.health_status()["reasons"]
+        # heal: the pop-hold pinned every missed record, so one drain
+        # round backfills the whole partition window
+        reg.heal()
+        assert reg.stream_now() > 0
+        assert reg.lag_versions() == 0
+        assert c.health_status()["verdict"] == "healthy"
+        # a failover now is loss-free even in async mode
+        sim.kill_primary_region()
+        events = c.detect_and_recruit()
+        assert ("region-failover", 0) in events
+        for i in range(20):
+            assert db[b"pre%03d" % i] == b"x"
+            assert db[b"cut%03d" % i] == b"y"
+    finally:
+        sim.close()
+
+
+def test_sync_mode_degrades_not_stalls_under_partition(tmp_path):
+    """Sync satellite mode during a WAN partition: commits still ACK
+    (degrade to async rather than stalling the commit path on the WAN),
+    every un-replicated ack is counted in sync_misses, and healing
+    backfills so the misses are recovered — a failover after the heal
+    loses nothing."""
+    sim = _region_sim(29, tmp_path, "sync")
+    try:
+        c = sim.cluster
+        db = sim.db
+        reg = c.regions
+        db[b"a"] = b"1"
+        assert reg.sync_misses == 0
+        assert reg.lag_versions() == 0  # sync: caught up per commit
+        reg.partition()
+        for i in range(10):
+            db[b"miss%02d" % i] = b"m"  # acks despite the dead WAN
+        # every client ack counted (internal system batches — e.g.
+        # idempotency GC — ride the same pipeline and may add more)
+        assert reg.sync_misses >= 10
+        assert "satellite_down" in c.health_status()["reasons"]
+        reg.heal()
+        db[b"b"] = b"2"  # first post-heal sync push backfills the gap
+        assert reg.lag_versions() == 0
+        sim.kill_primary_region()
+        assert ("region-failover", 0) in c.detect_and_recruit()
+        for i in range(10):
+            assert db[b"miss%02d" % i] == b"m"
+        assert db[b"a"] == b"1" and db[b"b"] == b"2"
+    finally:
+        sim.close()
+
+
+def test_failed_failover_retries_on_next_monitor_round(tmp_path,
+                                                       monkeypatch):
+    """A coordination failure mid-failover (the generation CAS loses
+    its quorum) leaves the roles dead and counts a failed attempt; the
+    NEXT failure-monitor round retries and succeeds — no data lost."""
+    sim = _region_sim(31, tmp_path, "sync")
+    try:
+        c = sim.cluster
+        db = sim.db
+        for i in range(15):
+            db[b"k%02d" % i] = b"v%02d" % i
+        orig = c._win_generation
+        state = {"failed": 0}
+
+        def flaky(recovered):
+            if state["failed"] == 0:
+                state["failed"] = 1
+                raise CoordinatorDown("injected quorum loss")
+            return orig(recovered)
+
+        monkeypatch.setattr(c, "_win_generation", flaky)
+        sim.kill_primary_region()
+        events = c.detect_and_recruit()
+        assert events == []  # round one lost to coordination
+        assert c.regions.failed_attempts == 1
+        assert c.regions.failovers == 0
+        events = c.detect_and_recruit()  # the monitor's next round
+        assert ("region-failover", 0) in events
+        st = c.regions.status()
+        assert st["failed_failover_attempts"] == 1
+        assert st["failovers"] == 1
+        for i in range(15):
+            assert db[b"k%02d" % i] == b"v%02d" % i
+    finally:
+        sim.close()
+
+
+def _chaos_fingerprint(seed, tmp_path, tag, engine):
+    sim = _region_sim(seed, tmp_path, "sync", engine, tag=tag)
+    try:
+        acked = {}
+        for a in range(2):
+            sim.add_workload(f"load{a}", _load_actor(sim, acked, a,
+                                                     rounds=80))
+        sim.add_workload("kill", _kill_actor(sim, at_step=50))
+        sim.run()
+        sim.quiesce()
+        tr = sim.db.create_transaction()
+        rows = tr.get_range(b"", b"\xff", limit=100_000)
+        return (
+            json.dumps([[k.decode("latin-1"), v.decode("latin-1")]
+                        for k, v in rows]),
+            json.dumps(sim.cluster.recovery_timeline.snapshot(),
+                       sort_keys=True),
+            json.dumps(sim.cluster.regions.status(), sort_keys=True),
+            sim.schedule_hash,
+        )
+    finally:
+        sim.close()
+
+
+@pytest.mark.parametrize("engine", ["memory", "redwood"])
+def test_same_seed_region_chaos_is_byte_identical(tmp_path, engine):
+    """The determinism acceptance bar, extended to the region
+    subsystem: two same-seed regional-disaster runs produce identical
+    final keyspaces, recovery timelines (phase stamps included), region
+    status documents (lag in ms included), and schedule hashes — the
+    streamer cadence rides the injected clock + the named
+    "region-stream" RNG stream, never wall time."""
+    a = _chaos_fingerprint(47, tmp_path, "a", engine)
+    b = _chaos_fingerprint(47, tmp_path, "b", engine)
+    assert a[0] == b[0]  # keyspace
+    assert a[1] == b[1]  # recovery timeline
+    assert a[2] == b[2]  # region status (lag, failover duration)
+    assert a[3] == b[3]  # schedule hash
+    # the runs really exercised the failover, not a quiet schedule
+    assert json.loads(a[2])["failovers"] == 1
+
+
+def test_fdbcli_configure_roundtrip_and_persistence(tmp_path):
+    """`configure regions=<json>` through the fdbcli surface: applies
+    live, shows in `status`, survives an ordinary txn-system recovery,
+    persists across a full restart via the \\xff/conf/regions row, and
+    `configure regions=off` clears it durably."""
+    import io
+
+    from foundationdb_tpu.server.cluster import Cluster
+    from foundationdb_tpu.tools.cli import Cli
+
+    wal = str(tmp_path / "primary.wal")
+    spec = ('{"primary":"east","remote":"west",'
+            '"satellites":1,"satellite_mode":"sync"}')
+    c = Cluster(resolver_backend="cpu", wal_path=wal, **TEST_KNOBS)
+    try:
+        db = c.database()
+        out = io.StringIO()
+        # the JSON is single-quoted at the shell so shlex keeps the
+        # double quotes intact (exactly how fdbcli operators quote it)
+        Cli(db, out=out).run_command(f"configure 'regions={spec}'")
+        assert c.regions is not None
+        assert c.regions.config.satellite_mode == "sync"
+        out = io.StringIO()
+        Cli(db, out=out).run_command("status")
+        text = out.getvalue()
+        assert "east" in text and "west" in text, text
+        assert "Replication lag" in text
+        db[b"k"] = b"v"
+        # an ordinary txn-system recovery must keep the subsystem
+        gen0 = c.generation
+        c.sequencer.kill()
+        c.detect_and_recruit()
+        assert c.generation > gen0
+        assert c.regions is not None and c.regions.replicating
+        db[b"k2"] = b"v2"
+        # a bad spec fails loudly and changes nothing
+        out = io.StringIO()
+        Cli(db, out=out).run_command(
+            "configure 'regions={\"primary\":\"x\"}'")
+        assert "ERROR" in out.getvalue()
+        assert c.regions.config.primary == "east"
+    finally:
+        c.close()
+    # full restart: the config row re-attaches replication
+    c = Cluster(resolver_backend="cpu", wal_path=wal, **TEST_KNOBS)
+    try:
+        assert c.regions is not None
+        assert c.regions.config.to_json() == \
+            __import__("json").dumps(__import__("json").loads(spec),
+                                     sort_keys=True)
+        db = c.database()
+        assert db[b"k"] == b"v" and db[b"k2"] == b"v2"
+        # regions=off detaches AND clears the row
+        io_out = io.StringIO()
+        Cli(db, out=io_out).run_command("configure regions=off")
+        assert c.regions is None
+    finally:
+        c.close()
+    c = Cluster(resolver_backend="cpu", wal_path=wal, **TEST_KNOBS)
+    try:
+        assert c.regions is None
+        assert c.status()["cluster"]["regions"] == {"configured": False}
+    finally:
+        c.close()

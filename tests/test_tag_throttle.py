@@ -66,6 +66,112 @@ def test_auto_throttle_busy_tag_under_pressure_then_release():
     assert "hog" not in rk.tag_limits
 
 
+def test_busyness_knob_throttles_without_global_pressure():
+    """The tag_throttle_busyness knob (ISSUE 14 satellite): a tag whose
+    admission share crosses the threshold gets its own limit even while
+    the cluster budget is perfectly healthy — no lag, no conflict trim.
+    The limit HOLDS while the tag stays dominant and regrows/releases
+    once it backs off."""
+    clock = FakeClock()
+    rk = Ratekeeper(target_tps=1e9, clock=clock, tag_busy_threshold=0.6)
+    # hog = 80% of 100 admissions across a 1s window, zero pressure
+    for i in range(100):
+        clock.advance(0.01)
+        rk.admit(tags=("hog",) if i % 5 else ())
+    rk.update(storage_lag_versions=0)  # healthy: only the knob acts
+    assert "hog" in rk.tag_limits
+    limit0 = rk.tag_limits["hog"]
+    assert limit0 <= 80.0 / 2 + 1  # half the observed rate
+    # the gate enforces: a hog burst mostly bounces with reason "tag"
+    clock.advance(1.0)
+    results = [rk.admit_with_reason(tags=("hog",)) for _ in range(100)]
+    denied = [r for ok, r in results if not ok]
+    assert denied and all(r == "tag" for r in denied)
+    # still dominant over a longer window (the capped tag re-earns its
+    # TAG_SAMPLE_MIN admissions across 3s): the limit holds, no regrow
+    for _ in range(3):
+        clock.advance(1.0)
+        for _ in range(100):
+            rk.admit(tags=("hog",))
+    rk.update(storage_lag_versions=0)
+    assert "hog" in rk.tag_limits
+    assert rk.tag_limits["hog"] <= limit0
+    # the tag backs off below threshold: healthy rounds release it
+    for _ in range(20):
+        clock.advance(1.0)
+        rk.update(storage_lag_versions=0)
+        if "hog" not in rk.tag_limits:
+            break
+    assert "hog" not in rk.tag_limits
+
+
+def test_busyness_knob_default_off():
+    """The default threshold 1.0 is OFF: a share can never exceed 1.0,
+    so a single-tag workload at 100% share runs unthrottled while the
+    cluster is healthy (the seed behavior, preserved)."""
+    clock = FakeClock()
+    rk = Ratekeeper(target_tps=1e9, clock=clock)
+    for _ in range(200):
+        clock.advance(0.005)
+        rk.admit(tags=("only",))
+    rk.update(storage_lag_versions=0)
+    assert rk.tag_limits == {}
+    clock.advance(1.0)
+    assert all(rk.admit(tags=("only",)) for _ in range(100))
+
+
+def test_busyness_knob_wired_through_cluster_and_status():
+    """End to end through the cluster: the knob reaches the ratekeeper,
+    a dominant tagged client gets capped at GRV with 1213 while the
+    cluster is healthy, and the enforced limit is visible as limit_tps
+    in the per-tag rollup (what `fdbcli top` prints)."""
+    clock = FakeClock()
+    c = Cluster(resolver_backend="cpu", target_tps=1e9, rk_clock=clock,
+                tag_throttle_busyness=0.6, **TEST_KNOBS)
+    assert c.ratekeeper.tag_busy_threshold == 0.6
+    db = c.database()
+    # the durability pump calls ratekeeper.update every pump_interval
+    # batches, which would reset the tag sample window before it holds
+    # TAG_SAMPLE_MIN admissions — park it so this test controls the
+    # control-loop cadence deterministically
+    for p in c._inner_proxies():
+        p.pump_interval = 10 ** 9
+    # the dominant tag: ~80% of admissions across the control window
+    for i in range(100):
+        clock.advance(0.01)
+        tr = db.create_transaction()
+        if i % 5:
+            tr.options.set_tag("hog")
+            tr[b"hot%03d" % i] = b"x"
+        else:
+            tr[b"good%03d" % i] = b"y"
+        tr.commit()
+    c.ratekeeper.update(storage_lag_versions=0)
+    assert "hog" in c.ratekeeper.tag_limits
+    clock.advance(1.0)
+    throttled = 0
+    for i in range(100):
+        tr = db.create_transaction()
+        tr.options.set_tag("hog")
+        tr[b"again%03d" % i] = b"z"
+        try:
+            tr.commit()
+        except FDBError as e:
+            assert e.code == 1213 and e.is_retryable
+            throttled += 1
+    assert throttled > 0
+    # untagged traffic still flows at full rate
+    for i in range(20):
+        tr = db.create_transaction()
+        tr[b"ok%03d" % i] = b"w"
+        tr.commit()
+    # visibility: the enforced limit rides the per-tag rollup
+    tags = c.hot_ranges_status()["tags"]
+    assert "limit_tps" in tags["hog"], tags
+    assert tags["hog"]["limit_tps"] > 0
+    c.close()
+
+
 def test_hot_tag_cannot_starve_well_behaved_client():
     """The VERDICT 'done' test: one hot-tag client spamming a quota'd
     tag keeps bouncing (1213) while an untagged client's transactions
