@@ -25,6 +25,13 @@ Flagged calls (outside ``sim/``, ``analysis/``, and the sanctioned seam
 
 ``time.monotonic`` / ``perf_counter`` / ``sleep`` are NOT flagged: they
 feed timeouts and metrics, not cluster-visible state.
+
+Manual-backoff extension: a loop that ``time.sleep``-s a delay it
+grows by multiplication IS flagged — that's a hand-rolled retry
+backoff bypassing ``utils/backoff.py``'s seam, so its schedule is
+unjittered (retrying fleets re-arrive in lockstep) and off the seeded
+``"backoff-jitter"`` stream (same-seed sims diverge). Route it
+through :class:`~foundationdb_tpu.utils.backoff.Backoff`.
 """
 
 import ast
@@ -50,7 +57,9 @@ BANNED_CALLS = {
 }
 
 EXEMPT_DIRS = ("sim/", "analysis/")
-EXEMPT_FILES = {"core/deterministic.py"}
+# deterministic.py: the clock/RNG seam. backoff.py: the backoff seam —
+# its sleep() IS the sanctioned grown-delay sleep the extension hunts.
+EXEMPT_FILES = {"core/deterministic.py", "utils/backoff.py"}
 
 
 def applies(relpath):
@@ -60,7 +69,68 @@ def applies(relpath):
     )
 
 
+def _dotted_refs(expr):
+    """Every statically-nameable Name/Attribute chain inside expr."""
+    out = set()
+    for n in ast.walk(expr):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            d = dotted_name(n)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+def _grown_delay_names(loop):
+    """Names a loop body grows multiplicatively: ``d *= 2`` or
+    ``d = min(cap, d * 2)`` — the hand-rolled backoff schedule."""
+    grown = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.AugAssign) and isinstance(
+            node.op, (ast.Mult, ast.Pow)
+        ):
+            d = dotted_name(node.target)
+            if d is not None:
+                grown.add(d)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            d = dotted_name(node.targets[0])
+            if d is None:
+                continue
+            has_mult = any(
+                isinstance(b, ast.BinOp)
+                and isinstance(b.op, (ast.Mult, ast.Pow))
+                for b in ast.walk(node.value)
+            )
+            if has_mult and d in _dotted_refs(node.value):
+                grown.add(d)
+    return grown
+
+
+def _manual_backoff_findings(tree, relpath):
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        grown = _grown_delay_names(loop)
+        if not grown:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if dotted_name(node.func) != "time.sleep":
+                continue
+            slept = _dotted_refs(node.args[0])
+            hit = sorted(slept & grown)
+            if hit:
+                yield Finding(
+                    RULE, relpath, node.lineno,
+                    f"manual backoff: loop sleeps '{hit[0]}' and grows "
+                    "it multiplicatively — route retry delays through "
+                    "utils.backoff.Backoff (jittered off the seeded "
+                    "'backoff-jitter' stream; resets on success)",
+                )
+
+
 def check(tree, relpath):
+    yield from _manual_backoff_findings(tree, relpath)
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and node.module == "random":
             yield Finding(

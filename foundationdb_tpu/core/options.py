@@ -169,6 +169,31 @@ class Knobs:
     # reference's default of auto-throttling being opt-in.
     tag_throttle_busyness: float = 1.0
 
+    # --- RPC deadlines & failure monitor (rpc/transport.py,
+    #     rpc/failuremon.py, rpc/service.py) ---
+    # per-class RPC deadlines: every remote call carries one, enforced
+    # by the client reader thread's deadline sweep (ref: per-request
+    # timeouts via flow's timeoutError). An expired commit-class call
+    # surfaces as commit_unknown_result (1021 — the txn MAY have
+    # committed); read/GRV/admin expiries are plainly retryable (1037).
+    rpc_deadline_read_s: float = 5.0
+    rpc_deadline_grv_s: float = 5.0
+    rpc_deadline_commit_s: float = 15.0
+    rpc_deadline_admin_s: float = 30.0
+    # per-endpoint health memory (ref: fdbrpc/FailureMonitor.actor.cpp):
+    # deadline/ECONNRESET marks the endpoint failed; the read router
+    # skips failed replicas; recovery is probed half-open with
+    # exponential spacing. Off = every caller rediscovers a dead worker
+    # by timing out against it (the pre-monitor behavior).
+    failure_monitor: bool = True
+    # keepalive ping cadence on idle client links (jittered off the
+    # "ping-cadence" deterministic stream); 0 disables the pinger
+    rpc_ping_interval_s: float = 2.0
+    # chaos transport arming (rpc/chaos.py): a non-empty seed wraps
+    # every NEW client socket in the seeded fault injector — test/bench
+    # only; "" keeps chaos entirely un-imported (the default path)
+    rpc_chaos_seed: str = ""
+
     # --- simulation ---
     # process-global BUGGIFY default (sim/buggify.py): `buggify` arms
     # the module-level BUGGIFY singleton at import (Simulation always

@@ -1,0 +1,190 @@
+"""Chaos transport — seeded fault injection on REAL sockets.
+
+Sim chaos (sim/network.py) proves the protocol; this module proves the
+LIVE stack: a wrapper around actual client sockets that injects the
+failure modes a production network serves up — added latency, dropped
+frames, duplicated frames, byte-trickle, close-mid-frame, and a
+permanent per-connection black hole (the wedged-peer shape the deadline
+sweep exists for).
+
+Faults are BUGGIFY-site-keyed (sim/buggify.py's two-level scheme rides
+the chaos seed): a site is activated for the whole run with
+``SITE_ACTIVATED_P``, then fires per-send with its own probability, so
+whole failure modes appear/disappear across seeds exactly like sim
+BUGGIFY. The seed (``arm(seed)`` / the ``rpc_chaos_seed`` knob /
+``FDB_TPU_CHAOS_SEED``) fully determines site activation and per-
+connection draw streams — a failing run is reproducible from its
+logged seed + activated-site list alone.
+
+Never importable into the default path: ``transport.SOCKET_WRAP`` stays
+``None`` until ``arm()`` runs; nothing imports this module otherwise.
+
+Injection is at ``sendall`` granularity — transport sends exactly one
+frame per ``sendall`` — so every fault is a whole-frame event except
+``close_mid_frame``/``trickle``, which deliberately split one. The
+first few sends of a connection (the auth handshake) are exempt: chaos
+targets the steady-state RPC path, not connection establishment.
+"""
+
+import random
+import time
+import zlib
+
+from foundationdb_tpu.rpc import transport
+from foundationdb_tpu.sim.buggify import Buggify
+from foundationdb_tpu.utils import lockdep
+from foundationdb_tpu.utils.trace import TraceEvent
+
+# (site, per-send fire probability) — activation per run is two-level
+SITES = (
+    ("chaos.delay", 0.10),
+    ("chaos.drop_frame", 0.05),
+    ("chaos.dup_frame", 0.05),
+    ("chaos.trickle", 0.05),
+    ("chaos.close_mid_frame", 0.02),
+    ("chaos.blackhole", 0.01),
+)
+SITE_ACTIVATED_P = 0.75
+_FIRE_P = dict(SITES)
+# auth handshake frames (proof + confirmation ack) pass untouched
+_HANDSHAKE_GRACE_SENDS = 2
+
+
+class _ChaosState:
+    def __init__(self, seed):
+        self.seed = str(seed)
+        # Buggify wants an integer seed; the knob/env accepts any
+        # string, so fold it through a stable checksum (NOT hash():
+        # PYTHONHASHSEED would break seed-reproducibility)
+        self.bug = Buggify(
+            seed=zlib.crc32(self.seed.encode()), enabled=True,
+            site_activated_p=SITE_ACTIVATED_P,
+        )
+        # pre-touch every site (fire_p=0 never fires) so
+        # activated_sites() is complete the moment chaos arms — the
+        # run's log line carries the full reproduction recipe up front
+        for site, _p in SITES:
+            self.bug(site, fire_p=0.0)
+        self._lock = lockdep.lock("chaos._ChaosState._lock")
+        self._conn_count = 0
+        self.stats = {}  # site -> injection count
+
+    def next_conn(self):
+        with self._lock:
+            self._conn_count += 1
+            return self._conn_count
+
+    def note(self, site):
+        with self._lock:
+            self.stats[site] = self.stats.get(site, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(sorted(self.stats.items()))
+
+
+_state = None  # set by arm()
+
+
+class ChaosSocket:
+    """Fault-injecting proxy over one real client socket.
+
+    Only ``sendall`` is intercepted; everything else (recv, timeouts,
+    close, shutdown) delegates, so the transport's framing, auth, and
+    deadline machinery run unmodified against the injected faults.
+    """
+
+    def __init__(self, sock, address, state):
+        self._sock = sock
+        self._address = address
+        self._chaos = state
+        conn = state.next_conn()
+        # per-connection draw stream derived from (seed, conn index):
+        # deterministic given the seed and connection order
+        self._rng = random.Random(f"{state.seed}:conn:{conn}")
+        self._sends = 0
+        self._blackholed = False
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+    def sendall(self, data):
+        self._sends += 1
+        if self._sends <= _HANDSHAKE_GRACE_SENDS:
+            return self._sock.sendall(data)
+        bug, rng = self._chaos.bug, self._rng
+        if self._blackholed:
+            self._chaos.note("chaos.blackhole")
+            return None  # swallowed; the peer never hears from us again
+        if bug("chaos.blackhole", fire_p=_FIRE_P["chaos.blackhole"]):
+            self._blackholed = True
+            self._chaos.note("chaos.blackhole")
+            return None
+        if bug("chaos.close_mid_frame",
+               fire_p=_FIRE_P["chaos.close_mid_frame"]):
+            self._chaos.note("chaos.close_mid_frame")
+            half = max(1, len(data) // 2)
+            try:
+                self._sock.sendall(data[:half])
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                f"chaos: closed {self._address} mid-frame")
+        if bug("chaos.drop_frame", fire_p=_FIRE_P["chaos.drop_frame"]):
+            self._chaos.note("chaos.drop_frame")
+            return None  # frame lost; the request's deadline will fire
+        if bug("chaos.delay", fire_p=_FIRE_P["chaos.delay"]):
+            self._chaos.note("chaos.delay")
+            time.sleep(rng.uniform(0.001, 0.03))
+        if bug("chaos.trickle", fire_p=_FIRE_P["chaos.trickle"]):
+            self._chaos.note("chaos.trickle")
+            step = rng.randint(3, 17)
+            for i in range(0, len(data), step):
+                self._sock.sendall(data[i:i + step])
+                time.sleep(0.0002)
+            return None
+        self._sock.sendall(data)
+        if bug("chaos.dup_frame", fire_p=_FIRE_P["chaos.dup_frame"]):
+            # the peer sees the same request seq twice — idempotency,
+            # not luck, must prevent double-apply
+            self._chaos.note("chaos.dup_frame")
+            self._sock.sendall(data)
+        return None
+
+
+def arm(seed):
+    """Arm chaos: every NEW client socket gets the seeded injector."""
+    global _state
+    _state = _ChaosState(seed)
+    transport.SOCKET_WRAP = (
+        lambda sock, address: ChaosSocket(sock, address, _state)
+    )
+    TraceEvent("ChaosArmed").detail(
+        seed=_state.seed,
+        activated_sites=",".join(_state.bug.activated_sites()),
+    ).log()
+    return _state
+
+
+def disarm():
+    """Back to the clean transport (existing wrapped sockets keep
+    their injectors until those connections die)."""
+    global _state
+    transport.SOCKET_WRAP = None
+    _state = None
+
+
+def armed():
+    return _state is not None
+
+
+def activated_sites():
+    return _state.bug.activated_sites() if _state is not None else []
+
+
+def stats():
+    return _state.snapshot() if _state is not None else {}

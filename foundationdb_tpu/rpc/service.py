@@ -32,17 +32,21 @@ import time
 
 from foundationdb_tpu.core import deterministic
 from foundationdb_tpu.core.errors import FDBError
-from foundationdb_tpu.core.options import Knobs
+from foundationdb_tpu.core.options import DEFAULT_KNOBS, Knobs
+from foundationdb_tpu.rpc import failuremon
 from foundationdb_tpu.rpc.transport import (
+    WEDGED_STRIKE_LIMIT,
     ConnectionLost,
+    DeadlineExceeded,
     RpcServer,
     connect_any,
 )
+from foundationdb_tpu.utils.backoff import Backoff
 from foundationdb_tpu.txn.futures import FutureRange, FutureValue
 from foundationdb_tpu.rpc.wire import PROTOCOL_VERSION
 from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import span as span_mod
-from foundationdb_tpu.utils.trace import TraceEvent
+from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
 
 
 # ───────────────────────────── cluster files ─────────────────────────────
@@ -106,6 +110,11 @@ class ClusterService:
     def handlers(self):
         return {
             "hello": self.hello,
+            # failure-monitor keepalive: cheapest possible liveness probe
+            # (ref: FailureMonitor's ping loop) — answers even while the
+            # storage/commit paths are busy, so it measures process
+            # liveness, not load
+            "ping": lambda: "pong",
             "knobs": self.knobs,
             "status": self.status,
             # the metrics section alone (monitoring agents poll this
@@ -361,6 +370,14 @@ def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16,
     non-loopback interface (the surface includes management access)."""
     from foundationdb_tpu.rpc.storageworker import LogFeed
 
+    # test/bench chaos arming by knob: a non-empty seed wraps every NEW
+    # client socket this process opens in the seeded fault injector
+    # (rpc/chaos.py stays un-imported on the default "" path)
+    chaos_seed = getattr(cluster.knobs, "rpc_chaos_seed", "")
+    if chaos_seed:
+        from foundationdb_tpu.rpc import chaos
+
+        chaos.arm(chaos_seed)
     service = ClusterService(cluster)
     server = RpcServer(host, port, service.handlers(),
                        max_workers=max_workers,
@@ -373,6 +390,29 @@ def serve_cluster(cluster, host="127.0.0.1", port=0, max_workers=16,
 
 
 # ───────────────────────────── client side ───────────────────────────────
+# RPC deadline classes: every method maps to one of the four per-class
+# deadline knobs (rpc_deadline_*_s). Unlisted methods are admin-class —
+# management/status calls tolerate the longest bound. watch_wait blocks
+# server-side in 5s chunks, safely under the admin deadline.
+_RPC_CLASS = {
+    "storage_get": "read",
+    "resolve_selector": "read",
+    "get_range": "read",
+    "read_batch": "read",
+    "ping": "read",
+    "get_read_version": "grv",
+    "commit": "commit",
+    "commit_batch": "commit",
+}
+
+
+def _class_deadline(knobs, rpc_class):
+    return {
+        "read": knobs.rpc_deadline_read_s,
+        "grv": knobs.rpc_deadline_grv_s,
+        "commit": knobs.rpc_deadline_commit_s,
+        "admin": knobs.rpc_deadline_admin_s,
+    }[rpc_class]
 class _RemoteWatch:
     """Client handle satisfying the Watch surface _WatchHandle polls."""
 
@@ -543,11 +583,23 @@ class _RemoteCommitProxy:
         except ConnectionLost:
             # the request may have reached the server: 1021, not a retry
             return FDBError.from_name("commit_unknown_result")
+        except FDBError as e:
+            if e.code != 1021:
+                raise
+            # deadline-expired commit (converted in _call_once): same
+            # maybe-committed contract, returned as a verdict because
+            # the proxy surface never raises
+            return e
 
     def commit_batch(self, requests):
         try:
             return self._rc._call_once("commit_batch", list(requests))
         except ConnectionLost:
+            return [FDBError.from_name("commit_unknown_result")
+                    for _ in requests]
+        except FDBError as e:
+            if e.code != 1021:
+                raise
             return [FDBError.from_name("commit_unknown_result")
                     for _ in requests]
 
@@ -573,9 +625,23 @@ class _RemoteStorage:
         worker = self._rc._next_worker(span)
         if worker is not None:
             try:
-                result = worker.call(method, *args)
+                result = worker.call(
+                    method, *args,
+                    deadline_s=self._rc._deadline_for(method),
+                )
                 self._rc._worker_ok(worker)
                 return result
+            except DeadlineExceeded:
+                # the worker is wedged, not dead: with the monitor on,
+                # mark it — the router skips it until a half-open probe
+                # clears; every other caller pays NOTHING. Monitor off
+                # (the pre-monitor behavior): it stays in rotation and
+                # each round-robin hit re-pays the deadline.
+                if self._rc._monitor_enabled():
+                    failuremon.monitor().note_timeout(
+                        f"{worker.host}:{worker.port}",
+                        f"{method} deadline",
+                    )
             except (ConnectionLost, OSError, RemoteError):
                 # dead socket OR a handler that faults server-side: this
                 # worker is not serving; stop routing to it
@@ -650,11 +716,26 @@ class RemoteCluster:
         self._worker_rr = 0
         self._worker_strikes = {}  # client -> consecutive 1009 lags
         self._read_batcher = None  # lazy: built on first async read
+        # jittered reconnect pacing shared by every idempotent retry on
+        # this handle (flow Backoff parity; reset on success)
+        self._reconnect_backoff = Backoff(initial_s=0.01, max_s=0.5)
         self.grv_proxy = _RemoteGrvProxy(self)
         self.commit_proxy = _RemoteCommitProxy(self)
         self.change_feeds = _RemoteChangeFeeds(self)
         self._storage = _RemoteStorage(self)
         self._connect()
+        # keepalive pinger: probes links that have gone quiet so the
+        # failure monitor learns about a wedged peer from the ping, not
+        # from the next real request's deadline (ref: FailureMonitor's
+        # ping loop). Cadence is jittered off the "ping-cadence" named
+        # stream; rpc_ping_interval_s == 0 disables the thread.
+        self._ping_stop = threading.Event()
+        self._ping_thread = None
+        if DEFAULT_KNOBS.rpc_ping_interval_s > 0:
+            self._ping_thread = threading.Thread(
+                target=self._ping_loop, name="rpc-keepalive", daemon=True
+            )
+            self._ping_thread.start()
         self.commit_pipeline = commit_pipeline
         if commit_pipeline == "thread":
             # concurrent client threads share GRV rounds too (ref:
@@ -680,6 +761,54 @@ class RemoteCluster:
         _, _, addresses = parse_cluster_file(path)
         return cls(addresses, **kw)
 
+    def _ping_loop(self):
+        rng = deterministic.rng("ping-cadence")
+        while True:
+            interval = self._effective_knobs().rpc_ping_interval_s
+            if interval <= 0:
+                # knob disabled server-side: stay parked but re-check
+                if self._ping_stop.wait(2.0):
+                    return
+                continue
+            # jittered cadence (0.5x..1.5x) so a fleet of clients does
+            # not ping a server in lockstep; the draw rides the named
+            # stream, so seeded runs schedule identically
+            if self._ping_stop.wait(interval * (0.5 + rng.random())):
+                return
+            try:
+                self._ping_idle_links(interval)
+            except Exception as e:
+                # the pinger is advisory: it must never kill itself —
+                # a failed probe round just runs again next tick
+                TraceEvent("KeepalivePingRoundFailed",
+                           severity=SEV_ERROR).detail(
+                    error=type(e).__name__).log()
+
+    def _ping_idle_links(self, interval):
+        from foundationdb_tpu.rpc.transport import RemoteError
+
+        if not self._monitor_enabled():
+            return
+        with self._lock:
+            clients = [self._client] + [c for c, _ in self._workers]
+        mon = failuremon.monitor()
+        for c in clients:
+            if c is None or not c.alive:
+                continue
+            if time.monotonic() - c.last_activity < interval:
+                continue  # link is carrying traffic; liveness is known
+            addr = f"{c.host}:{c.port}"
+            try:
+                c.call("ping",
+                       deadline_s=min(1.0, self._deadline_for("ping")))
+                mon.mark_ok(addr)
+            except DeadlineExceeded:
+                mon.note_timeout(addr, "keepalive ping")
+            except (ConnectionLost, OSError) as e:
+                mon.mark_failed(addr, f"keepalive: {e}")
+            except RemoteError:
+                pass  # peer predates the ping endpoint: no health signal
+
     def _connect(self):
         with self._lock:
             if self._closed:
@@ -693,7 +822,20 @@ class RemoteCluster:
             self._client = connect_any(
                 self.addresses, self._connect_timeout, secret=self._secret
             )
-            hello = self._client.call("hello", PROTOCOL_VERSION)
+            try:
+                # the admin deadline bounds the handshake: a freshly
+                # accepted but black-holed connection must surface as
+                # unreachable, not park the caller forever
+                hello = self._client.call(
+                    "hello", PROTOCOL_VERSION,
+                    deadline_s=self._deadline_for("hello"),
+                )
+            except DeadlineExceeded as e:
+                self._client.close()
+                raise ConnectionLost(
+                    f"handshake with {self._client.host}:"
+                    f"{self._client.port} timed out: {e}"
+                ) from e
             generation = hello["generation"]
             prior = getattr(self, "server_generation", None)
             if prior is not None and generation != prior:
@@ -707,25 +849,71 @@ class RemoteCluster:
             self.server_generation = generation
             return self._client
 
+    def _effective_knobs(self):
+        """Cached server knobs when we have them, DEFAULT_KNOBS before —
+        NEVER the ``knobs`` property: the deadline for the knobs fetch
+        itself must not recurse into a knobs fetch."""
+        return self._knobs if self._knobs is not None else DEFAULT_KNOBS
+
+    def _deadline_for(self, method):
+        return _class_deadline(
+            self._effective_knobs(), _RPC_CLASS.get(method, "admin")
+        )
+
+    def _monitor_enabled(self):
+        kn = self._effective_knobs()
+        return kn.failure_monitor
+
     def _call_once(self, method, *args):
         """One attempt, no reconnect — the commit path's no-double-send
-        rule."""
+        rule. Every attempt carries its class deadline; an expiry is
+        converted here: commit-class → commit_unknown_result (1021, the
+        request MAY have reached the server), anything else →
+        process_behind (1037, plainly retryable) — and the endpoint is
+        marked in the failure monitor either way."""
         client = self._client
         if client is None or not client.alive:
             client = self._connect()
+        addr = f"{client.host}:{client.port}"
         try:
-            return client.call(method, *args)
+            result = client.call(
+                method, *args, deadline_s=self._deadline_for(method)
+            )
+        except DeadlineExceeded as e:
+            failuremon.monitor().note_timeout(addr, f"{method} deadline")
+            if client.deadline_strikes >= WEDGED_STRIKE_LIMIT:
+                # a black-holed link looks exactly like a slow one until
+                # several consecutive deadlines expire with no frame in
+                # either direction: stop re-paying the deadline on every
+                # retry — kill the socket so the NEXT attempt reconnects
+                # fresh (connection-level escape; the retry itself still
+                # belongs to the caller's on_error loop)
+                TraceEvent("RpcLinkWedged", severity=SEV_ERROR).detail(
+                    address=addr, method=method,
+                    strikes=client.deadline_strikes).log()
+                client.close()
+            if _RPC_CLASS.get(method, "admin") == "commit":
+                raise FDBError.from_name("commit_unknown_result") from e
+            raise FDBError.from_name("process_behind") from e
         except (ConnectionLost, OSError) as e:
+            failuremon.monitor().mark_failed(addr, f"{method}: {e}")
             raise ConnectionLost(str(e)) from e
+        failuremon.monitor().mark_ok(addr)
+        return result
 
     def _call(self, method, *args):
         """Idempotent call: one transparent reconnect+retry (reads, GRVs,
-        watches are all safe to re-send)."""
+        watches are all safe to re-send), with a jittered backoff sleep
+        before the reconnect so a fleet of clients doesn't stampede a
+        recovering server (flow Backoff parity; resets on success)."""
         try:
-            return self._call_once(method, *args)
+            result = self._call_once(method, *args)
         except ConnectionLost:
+            self._reconnect_backoff.sleep()
             self._connect()  # raises ConnectionLost if nobody is reachable
-            return self._call_once(method, *args)
+            result = self._call_once(method, *args)
+        self._reconnect_backoff.reset()
+        return result
 
     @property
     def knobs(self):
@@ -753,6 +941,9 @@ class RemoteCluster:
                     max_keys=kn.read_batch_max_keys,
                     window_s=kn.read_batch_window_ms / 1e3,
                     thread=(self.commit_pipeline == "thread"),
+                    # a batch retried once on the lead may pay the read
+                    # deadline twice before the watchdog should step in
+                    deadline_s=2 * kn.rpc_deadline_read_s,
                 )
             return self._read_batcher
 
@@ -787,7 +978,18 @@ class RemoteCluster:
         worker = self._next_worker(self._batch_span(ops))
         if worker is not None:
             try:
-                slots = worker.call("read_batch", ops)
+                slots = worker.call(
+                    "read_batch", ops,
+                    deadline_s=self._deadline_for("read_batch"),
+                )
+            except DeadlineExceeded:
+                # wedged worker: mark (monitor on) and serve the whole
+                # batch from the lead — same policy as _RemoteStorage
+                if self._monitor_enabled():
+                    failuremon.monitor().note_timeout(
+                        f"{worker.host}:{worker.port}",
+                        "read_batch deadline",
+                    )
             except (ConnectionLost, OSError, RemoteError):
                 self._drop_worker(worker)
             else:
@@ -817,7 +1019,12 @@ class RemoteCluster:
         return self._call("metrics")
 
     def health_status(self):
-        return self._call("health")
+        doc = self._call("health")
+        # overlay THIS client's endpoint-health view (the server's own
+        # monitor can't see our links): states + counters only
+        if isinstance(doc, dict):
+            doc["rpc_client"] = failuremon.monitor().snapshot()
+        return doc
 
     def hot_ranges_status(self, top=None):
         return self._call("metrics_hot", top)
@@ -929,11 +1136,17 @@ class RemoteCluster:
 
     def _next_worker(self, span=None):
         """Round-robin over lead + covering workers: returns None for
-        'the lead's turn' (callers fall through to _call)."""
+        'the lead's turn' (callers fall through to _call). With the
+        failure monitor on, known-failed workers are skipped instead of
+        serially timed out against — except for the one caller per probe
+        window that ``available`` elects to carry the recovery probe."""
+        monitor_on = self._monitor_enabled()
+        mon = failuremon.monitor() if monitor_on else None
         with self._lock:
             eligible = [
                 c for c, ranges in self._workers
                 if self._covers(ranges, span)
+                and (mon is None or mon.available(f"{c.host}:{c.port}"))
             ]
             if not eligible:
                 return None
@@ -956,6 +1169,8 @@ class RemoteCluster:
     def _worker_ok(self, client):
         with self._lock:
             self._worker_strikes.pop(client, None)
+        # a successful read doubles as the recovery probe's verdict
+        failuremon.monitor().mark_ok(f"{client.host}:{client.port}")
 
     def _worker_strike(self, client):
         with self._lock:
@@ -986,6 +1201,9 @@ class RemoteCluster:
         return Database(self)
 
     def close(self):
+        self._ping_stop.set()
+        if self._ping_thread is not None:
+            self._ping_thread.join(timeout=1)
         rb = self._read_batcher
         if rb is not None:
             rb.close()  # settles queued reads retryably (FL002)

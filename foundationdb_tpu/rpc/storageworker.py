@@ -201,6 +201,7 @@ class StorageWorker:
         self.position = 0  # last applied log version
         self._stop = threading.Event()
         self._caught_up = threading.Event()
+        self._detach_error = None  # set iff the pull loop died
         self._thread = None
         self._client = None
         self._lock = lockdep.lock("StorageWorker._lock")
@@ -236,6 +237,8 @@ class StorageWorker:
         except (ConnectionLost, RemoteError, OSError, FDBError) as e:
             # FDBError included: a too-slow bootstrap can get 1007 from
             # the lead — detach cleanly, don't die with a raw traceback
+            self._detach_error = e
+            self._caught_up.set()  # wake waiters; they see the error
             TraceEvent("StorageWorkerDetached", severity=30).detail(
                 name=self.name, error=str(e)[:120]).log()
 
@@ -345,8 +348,19 @@ class StorageWorker:
             self._last_refresh = now
 
     def wait_caught_up(self, timeout=30.0):
+        """Block until the bootstrap finished. Failure is always a
+        CODED retryable FDBError — never a raw TimeoutError — so a
+        caller's on_error loop treats a slow or detached worker like
+        any lagging storage (1037: behind, catch up and retry)."""
         if not self._caught_up.wait(timeout):
-            raise TimeoutError(f"{self.name} never bootstrapped")
+            raise FDBError(1037, f"{self.name} still bootstrapping "
+                                 f"(process_behind)")
+        if self._detach_error is not None:
+            raise FDBError(
+                1037,
+                f"{self.name} detached during bootstrap: "
+                f"{str(self._detach_error)[:120]}",
+            )
 
     # ── read surface (version-waiting, ref: waitForVersion) ──
     def _wait_version(self, rv, timeout=5.0):
@@ -441,7 +455,8 @@ class StorageWorker:
             "name": self.name,
             "version": self.storage.version,
             "position": self.position,
-            "caught_up": self._caught_up.is_set(),
+            "caught_up": (self._caught_up.is_set()
+                          and self._detach_error is None),
             "tag": self.tag,
             "bytes_pulled": self.bytes_pulled,
         }
@@ -453,6 +468,8 @@ class StorageWorker:
             "resolve_selector": self.resolve_selector,
             "read_batch": self.read_batch,
             "worker_status": self.worker_status,
+            # liveness probe for the client failure monitor's keepalive
+            "ping": lambda: "pong",
         }
 
     def serve(self, host="127.0.0.1", port=0):

@@ -26,6 +26,7 @@ import os
 import socket
 import struct
 import threading
+import time
 
 from concurrent.futures import Future, ThreadPoolExecutor
 
@@ -38,6 +39,50 @@ from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
 MAX_FRAME = 64 * 1024 * 1024
 _AUTH_CONTEXT = b"fdbtpu-rpc-auth-v1:"
 _AUTH_HANDSHAKE_TIMEOUT_S = 5.0
+# deadline-sweep cadence: the client reader blocks in recv at most this
+# long before checking outstanding requests against their deadlines, so
+# a wedged peer costs one deadline + one tick, never a hung thread
+_DEADLINE_TICK_S = 0.05
+# consecutive deadline sweeps (with zero frames received in between)
+# after which a connection is presumed black-holed rather than slow:
+# callers close it and reconnect on a fresh socket instead of paying
+# the full deadline again on a link that will never answer
+WEDGED_STRIKE_LIMIT = 3
+
+# Chaos transport hook (rpc/chaos.py): when armed, every NEW client
+# socket is wrapped in the seeded fault injector. None on the default
+# path — chaos code is never even imported unless a seed arms it via
+# chaos.arm()/the rpc_chaos_seed knob/FDB_TPU_CHAOS_SEED.
+SOCKET_WRAP = None
+
+
+def _socket_wrap():
+    global SOCKET_WRAP
+    if SOCKET_WRAP is None:
+        seed = os.environ.get("FDB_TPU_CHAOS_SEED")
+        if seed:
+            from foundationdb_tpu.rpc import chaos
+
+            chaos.arm(seed)  # sets SOCKET_WRAP
+    return SOCKET_WRAP
+
+
+class DeadlineExceeded(TimeoutError):
+    """A request outlived its deadline; the connection itself is fine.
+
+    The service layer maps this by RPC class: commit-class calls become
+    ``commit_unknown_result`` (1021 — the txn MAY have committed),
+    read/GRV/admin calls become plainly retryable errors.
+    """
+
+    def __init__(self, method, deadline_s, address=""):
+        super().__init__(
+            f"rpc {method!r} to {address or '?'} exceeded its "
+            f"{deadline_s:.3f}s deadline"
+        )
+        self.method = method
+        self.deadline_s = deadline_s
+        self.address = address
 
 
 def _auth_proof(secret, nonce):
@@ -77,6 +122,40 @@ def _recv_frame(sock):
     if n > MAX_FRAME:
         raise ConnectionLost(f"oversized frame: {n}")
     return _recv_exact(sock, n)
+
+
+class _FrameReader:
+    """Buffered frame reader that survives ``socket.timeout`` mid-frame.
+
+    The client reader runs its socket with a short timeout so it can
+    sweep request deadlines between frames. ``_recv_exact`` would LOSE
+    partially-received bytes on a timeout and desync the stream; this
+    reader keeps partial state across ticks, so a timeout is always a
+    clean "nothing complete yet — go sweep" signal.
+    """
+
+    def __init__(self, sock):
+        self._sock = sock
+        self._buf = bytearray()
+        self._need = None  # payload length once the header is parsed
+
+    def recv_frame(self):
+        while True:
+            if self._need is None and len(self._buf) >= 4:
+                (n,) = struct.unpack(">I", bytes(self._buf[:4]))
+                if n > MAX_FRAME:
+                    raise ConnectionLost(f"oversized frame: {n}")
+                del self._buf[:4]
+                self._need = n
+            if self._need is not None and len(self._buf) >= self._need:
+                payload = bytes(self._buf[: self._need])
+                del self._buf[: self._need]
+                self._need = None
+                return payload
+            chunk = self._sock.recv(65536)  # may raise socket.timeout
+            if not chunk:
+                raise ConnectionLost("peer closed")
+            self._buf += chunk
 
 
 class RpcServer:
@@ -294,6 +373,9 @@ class RpcClient:
         self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        wrap = _socket_wrap()
+        if wrap is not None:
+            self._sock = wrap(self._sock, f"{host}:{port}")
         self._send_lock = lockdep.lock("RpcClient._send_lock")
         if secret is not None:
             # the server's first frame is the auth nonce; answer before
@@ -319,23 +401,51 @@ class RpcClient:
                     f"mismatch or server not configured for auth: {e!r}"
                 ) from e
         self._state_lock = lockdep.lock("RpcClient._state_lock")
-        self._pending = {}  # seq -> Future
+        # seq -> (Future, expires_monotonic|None, method, deadline_s)
+        self._pending = {}
         self._seq = 0
         self._closed = False
+        # consecutive deadline expiries with NO intervening reply: a
+        # black-holed link looks exactly like a slow one, so callers use
+        # this to stop re-paying full deadlines on a dead connection
+        # (see WEDGED_STRIKE_LIMIT). Single int under the GIL; the
+        # reader thread writes, callers only compare against the limit.
+        # flowlint: shared(GIL-atomic counter; a stale read delays one reconnect)
+        self.deadline_strikes = 0
+        # monotonic stamp of the last frame sent or received: the
+        # keepalive pinger only probes links that have gone quiet
+        # monotonic heartbeat for keepalive idleness: a single float
+        # store under the GIL — a stale read only delays or duplicates
+        # one advisory ping, so writers stay lockless by design.
+        # flowlint: shared(GIL-atomic heartbeat; staleness is benign)
+        self.last_activity = time.monotonic()
         self._reader = threading.Thread(
             target=self._read_loop, name="rpc-client-reader", daemon=True
         )
         self._reader.start()
 
     def _read_loop(self):
+        reader = _FrameReader(self._sock)
         try:
+            # short recv timeout = the deadline-sweep tick; a wedged or
+            # silent peer can no longer park this thread forever
+            self._sock.settimeout(_DEADLINE_TICK_S)
             while True:
-                frame = _recv_frame(self._sock)
+                try:
+                    frame = reader.recv_frame()
+                except socket.timeout:
+                    self._sweep_deadlines()
+                    continue
+                self.last_activity = time.monotonic()
+                self.deadline_strikes = 0  # the link demonstrably moves data
                 kind, seq, ok, payload = wire.loads(frame)
                 with self._state_lock:
-                    fut = self._pending.pop(seq, None)
-                if fut is None:
+                    entry = self._pending.pop(seq, None)
+                if entry is None:
                     continue  # cancelled/timed-out request
+                fut = entry[0]
+                if fut.done():
+                    continue  # already deadline-settled
                 if ok:
                     fut.set_result(payload)
                 elif isinstance(payload, FDBError):
@@ -345,6 +455,29 @@ class RpcClient:
         except (ConnectionLost, ConnectionError, OSError, ValueError) as e:
             self._fail_all(e)
 
+    def _sweep_deadlines(self):
+        """Settle every request past its deadline with DeadlineExceeded.
+
+        The connection stays up: a slow reply to a swept seq is dropped
+        by the reader, and unexpired requests keep waiting. Futures are
+        settled OUTSIDE the state lock (FL003: callbacks may block)."""
+        now = time.monotonic()
+        expired = []
+        with self._state_lock:
+            for seq, entry in list(self._pending.items()):
+                expires = entry[1]
+                if expires is not None and now >= expires:
+                    expired.append(entry)
+                    del self._pending[seq]
+        if expired:
+            self.deadline_strikes += 1
+        for fut, _expires, method, deadline_s in expired:
+            if not fut.done():
+                fut.set_exception(DeadlineExceeded(
+                    method, deadline_s,
+                    address=f"{self.host}:{self.port}",
+                ))
+
     def _fail_all(self, exc):
         with self._state_lock:
             self._closed = True
@@ -353,7 +486,8 @@ class RpcClient:
             self._sock.close()  # no fd leak across reconnect cycles
         except OSError:
             pass
-        for fut in pending.values():
+        for entry in pending.values():
+            fut = entry[0]
             if not fut.done():
                 fut.set_exception(ConnectionLost(str(exc)))
 
@@ -361,14 +495,17 @@ class RpcClient:
     def alive(self):
         return not self._closed
 
-    def call_async(self, method, *args) -> Future:
+    def call_async(self, method, *args, deadline_s=None) -> Future:
         fut = Future()
+        expires = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
         with self._state_lock:
             if self._closed:
                 raise ConnectionLost("connection closed")
             self._seq += 1
             seq = self._seq
-            self._pending[seq] = fut
+            self._pending[seq] = (fut, expires, method, deadline_s)
         # the thread's ambient SpanContext (a sampled client span) rides
         # as the optional v5 tracing frame; untraced calls keep the
         # 4-tuple form byte-for-byte
@@ -377,6 +514,7 @@ class RpcClient:
             else ("q", seq, method, tuple(args), ctx)
         try:
             _send_frame(self._sock, self._send_lock, wire.dumps(msg))
+            self.last_activity = time.monotonic()
         except (ConnectionError, OSError) as e:
             with self._state_lock:
                 self._pending.pop(seq, None)
@@ -390,8 +528,10 @@ class RpcClient:
             raise
         return fut
 
-    def call(self, method, *args, timeout=None):
-        return self.call_async(method, *args).result(timeout=timeout)
+    def call(self, method, *args, timeout=None, deadline_s=None):
+        return self.call_async(
+            method, *args, deadline_s=deadline_s
+        ).result(timeout=timeout)
 
     def close(self):
         with self._state_lock:

@@ -69,23 +69,45 @@ class CommitFuture:
         return self._result is not _UNSET
 
     def set(self, result):
-        self._result = result
+        # first settlement wins: once a waiter may have observed a
+        # verdict (e.g. the stranded-batch watchdog's 1021, already
+        # acted on by a retry), a late real result must not replace it
+        # — an acked-then-changed verdict is how double-applies happen
+        if self._result is _UNSET:
+            self._result = result
 
     def result(self, timeout=None):
-        """Block until resolved (thread mode); returns version or FDBError."""
+        """Block until resolved (thread mode); returns version or FDBError.
+
+        Waits in bounded chunks, invoking the proxy's stranded-batch
+        watchdog between them: a batch wedged inside the inner proxy
+        past the commit deadline settles as commit_unknown_result on
+        the WAITING thread — a hung pipeline costs a deadline, never a
+        hung client (FL002 settle-and-retry)."""
         if self._result is not _UNSET:
             return self._result
         if self._proxy is None:
             raise TimeoutError("standalone commit future never resolved")
         cond = self._proxy._done_cond
-        with cond:
-            if not cond.wait_for(self.done, timeout):
-                raise TimeoutError("commit future not resolved")
-        return self._result
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            chunk = 0.25
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and not self.done():
+                    raise TimeoutError("commit future not resolved")
+                chunk = min(chunk, max(0.0, remaining))
+            with cond:
+                cond.wait_for(self.done, chunk)
+            if self.done():
+                return self._result
+            self._proxy._check_stranded()
 
 
 class BatchingCommitProxy:
     """Accumulates CommitRequests into shared-version batches."""
+
+    WATCHDOG_GRACE_S = 1.0
 
     def __init__(self, inner, max_batch=None, interval_s=None,
                  flush_after=4, mode="thread"):
@@ -106,6 +128,17 @@ class BatchingCommitProxy:
         self._wake = lockdep.condition("BatchingCommitProxy._lock", self._lock)
         self._done_cond = lockdep.condition("BatchingCommitProxy._done_cond")  # batch-completion waiters
         self._closed = False
+        # stranded-batch watchdog bound: a batch inside the inner proxy
+        # longer than this settles 1021 from the waiting client thread.
+        # Two commit deadlines of slack — the inner proxy may itself be
+        # a deadline-bounded RPC that retries once — plus grace.
+        self.watchdog_s = (
+            2 * getattr(knobs, "rpc_deadline_commit_s", 15.0)
+            + self.WATCHDOG_GRACE_S
+        )
+        self._running = None  # batch currently driving the inner proxy
+        self._running_since = 0.0
+        self.stranded_settled = 0
         self.batches_committed = 0
         self.txns_batched = 0
         self.max_batch_seen = 0
@@ -240,7 +273,41 @@ class BatchingCommitProxy:
                 self.MAX_BACKLOG, self._backlog_target * 2
             )
 
+    def _check_stranded(self):
+        """Stranded-batch watchdog (invoked by waiting clients between
+        wait chunks): a batch that has been driving the inner proxy
+        past ``watchdog_s`` settles every future in it with 1021 — the
+        commits MAY have happened; the retry loop's idempotency ids own
+        the disambiguation. The wedged drive keeps running; its eventual
+        ``set`` calls lose to the watchdog's (first settlement wins)."""
+        with self._lock:
+            run = self._running
+            if run is None \
+                    or time.monotonic() - self._running_since \
+                    < self.watchdog_s:
+                return
+            self._running = None  # claimed: exactly one waiter settles
+            self.stranded_settled += len(run)
+        TraceEvent("CommitBatchStranded", severity=30).detail(
+            txns=len(run), bound_s=self.watchdog_s).log()
+        unknown = FDBError.from_name("commit_unknown_result")
+        for _, fut in run:
+            fut.set(unknown)
+        with self._done_cond:
+            self._done_cond.notify_all()
+
     def _run_batch(self, pending):
+        with self._lock:
+            self._running = pending
+            self._running_since = time.monotonic()
+        try:
+            self._run_batch_inner(pending)
+        finally:
+            with self._lock:
+                if self._running is pending:
+                    self._running = None
+
+    def _run_batch_inner(self, pending):
         chunks = [
             pending[i : i + self.max_batch]
             for i in range(0, len(pending), self.max_batch)

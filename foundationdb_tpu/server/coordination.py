@@ -26,6 +26,7 @@ import os
 import threading
 
 from foundationdb_tpu.utils import lockdep
+from foundationdb_tpu.utils.backoff import Backoff
 
 
 class CoordinatorDown(Exception):
@@ -178,7 +179,13 @@ class CoordinationQuorum:
         Raises CoordinatorDown if no majority is reachable. Returns the
         ballot at which the state was committed.
         """
-        for _ in range(10):  # retry on ballot races with other proposers
+        # ballot races with other proposers: retry with a tiny jittered
+        # backoff — two proposers in lockstep re-race every round
+        # forever; jittered sleeps break the symmetry (flow Backoff)
+        cas_backoff = Backoff(initial_s=0.001, max_s=0.05)
+        for attempt in range(10):
+            if attempt:
+                cas_backoff.sleep()
             prior, ballot = self._prepare_retrying()
             if expect_generation is not None:
                 prior_gen = (prior or {}).get("generation", 0)
@@ -196,7 +203,10 @@ class CoordinationQuorum:
         raise CoordinatorDown("could not commit cluster state (ballot races)")
 
     def _prepare_retrying(self, attempts=10):
-        for _ in range(attempts):
+        backoff = Backoff(initial_s=0.001, max_s=0.05)
+        for attempt in range(attempts):
+            if attempt:
+                backoff.sleep()  # desynchronize competing proposers
             try:
                 return self._prepare_round()
             except _BallotOutdated:

@@ -19,6 +19,7 @@ import time
 
 from foundationdb_tpu.core.errors import err
 from foundationdb_tpu.utils import lockdep
+from foundationdb_tpu.utils.backoff import Backoff
 from foundationdb_tpu.utils import metrics as metrics_mod
 from foundationdb_tpu.utils import span as span_mod
 
@@ -190,7 +191,13 @@ class BatchingGrvProxy:
         return fut["value"]
 
     def _grant_loop(self):
-        sleep_s = self.interval_s
+        # throttled rounds back off exponentially (cap 20ms) instead of
+        # hammering the bucket every half millisecond; a granting round
+        # resets to the base batch interval. jitter=0: this is a batch
+        # cadence, not a retrying fleet — lockstep is harmless and the
+        # unjittered schedule keeps thread-mode timing unchanged.
+        throttle = Backoff(initial_s=self.interval_s, max_s=0.02,
+                           growth=2.0, jitter=0.0)
         while True:
             # acquire via the Condition (it wraps self._lock, so this IS
             # the same mutex): waiting on the object we hold makes the
@@ -215,15 +222,13 @@ class BatchingGrvProxy:
             # lone request waits briefly for companions; under continuous
             # load the previous round's processing time IS the window —
             # sleeping on top of it would only tax per-client latency
+            sleep_s = throttle.current
             if n_waiting < 2 or sleep_s > self.interval_s:
                 time.sleep(sleep_s)
-            granted_any = self._grant_round()
-            # throttled rounds back off exponentially (cap 20ms) instead
-            # of hammering the bucket every half millisecond
-            sleep_s = (
-                self.interval_s if granted_any
-                else min(0.02, sleep_s * 2)
-            )
+            if self._grant_round():
+                throttle.reset()
+            else:
+                throttle.delay()
 
     @staticmethod
     def _make_future(priority, born=None):

@@ -283,6 +283,10 @@ _MESSAGES = {
                                  "re-seeded before it can fail over.",
     "satellite_down": "The satellite region is unreachable (WAN "
                       "partition); replication lag is growing.",
+    "rpc_endpoints_failed": "The failure monitor holds one or more RPC "
+                            "endpoints marked failed; calls to them "
+                            "are being skipped until a recovery probe "
+                            "succeeds.",
 }
 
 
@@ -378,6 +382,15 @@ def build_health(cluster):
         if (regions_doc["replication_lag_versions"]
                 > knobs.doctor_region_lag_versions):
             degraded.add("region_lag")
+    # ── RPC endpoint health (rpc/failuremon.py) ──
+    # this process's failure-monitor view: which peers it is currently
+    # routing around, plus the timeout/failure tallies. snapshot() is
+    # wall-time free, so same-seed sim health docs stay byte-identical.
+    from foundationdb_tpu.rpc import failuremon
+
+    rpc_doc = failuremon.monitor().snapshot()
+    if rpc_doc["failed"]:
+        degraded.add("rpc_endpoints_failed")
     prober = getattr(cluster, "prober", None)
     probe_doc = prober.status() if prober is not None else {
         "enabled": False, "probes": 0, "failures": 0, "last_error": None,
@@ -420,4 +433,5 @@ def build_health(cluster):
         },
         "ratekeeper": rk_doc,
         "regions": regions_doc,
+        "rpc": rpc_doc,
     }

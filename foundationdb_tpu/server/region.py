@@ -428,17 +428,26 @@ class RegionReplicator:
         self._thread.start()
 
     def _loop(self):
+        from foundationdb_tpu.utils.backoff import Backoff
         from foundationdb_tpu.utils.trace import SEV_ERROR
 
         interval = self.cluster.knobs.region_stream_interval_s
-        while not self._stop.wait(interval):
+        # heal-retry: a drain that keeps failing (WAN flapping, satellite
+        # log mid-restart) widens the retry spacing instead of hammering
+        # at the stream cadence; one clean round snaps it back
+        retry = Backoff(initial_s=interval, max_s=max(interval * 8, 1.0))
+        wait_s = interval
+        while not self._stop.wait(wait_s):
             try:
                 self.maybe_stream()
+                retry.reset()
+                wait_s = interval
             except Exception as e:
                 # the streamer must never take the cluster down — but a
                 # broken drain is forensics-worthy, not silence
                 TraceEvent("RegionStreamError", severity=SEV_ERROR) \
                     .detail(error=repr(e))
+                wait_s = retry.delay()
 
     def stop(self):
         self._stop.set()

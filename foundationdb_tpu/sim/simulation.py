@@ -278,6 +278,16 @@ class Simulation:
             if reg is not None:
                 reg.maybe_stream()
         self._actors = []
+        # surface WHICH buggify sites this seed activated: a failing
+        # seed's repro starts from this line (and a same-seed rerun
+        # must print the identical list — activation is seed-keyed).
+        # Tests may swap self.buggify for a plain boosting wrapper fn;
+        # the activation list is best-effort then, not an attribute err
+        sites = getattr(self.buggify, "activated_sites", None)
+        TraceEvent("SimBuggifySites").detail(
+            seed=self.seed, steps=self.steps,
+            activated=",".join(sites()) if sites else "(wrapped)",
+        ).log()
 
     # steps between failure-monitor rounds: kills stay undetected for a
     # window, so clients really do hit (and retry through) dead roles

@@ -48,6 +48,11 @@ LOWER_BETTER = (
     # count or failover duration are regressions too ("failover" covers
     # region_failovers and last_failover_ms alike)
     "replication_lag", "failover",
+    # robustness stack (ISSUE 15): more RPC deadline expiries, more
+    # endpoints marked failed, or more backoff sleeps taken on a
+    # healthy run are regressions ("robustness_overhead_pct" already
+    # resolves via "overhead_pct" above)
+    "rpc_timeouts", "endpoints_failed", "backoff_retries",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",

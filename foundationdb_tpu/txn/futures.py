@@ -92,8 +92,18 @@ class FutureValue:
             if b is None:
                 raise err("client_invalid_operation")
             cond = b._done_cond
-            with cond:
-                cond.wait_for(self.done)
+            # bounded waits + the batcher's stranded-batch watchdog:
+            # if the in-flight send outlives its deadline (a wedged
+            # peer past even the transport's sweep), the WAITER settles
+            # the batch retryably instead of parking forever (FL002's
+            # settle-and-retry, not teardown-or-hang). The condition is
+            # never held while the watchdog runs — no lock-order edge
+            # between _done_cond and the batcher's queue lock.
+            while not self.done():
+                with cond:
+                    cond.wait_for(self.done, timeout=0.25)
+                if not self.done():
+                    b.check_stranded()
         fin, self._finalize = self._finalize, None
         e = self._error
         if e is not None:
@@ -154,14 +164,24 @@ class ReadBatcher:
     retryable error (the client retry loop owns it from there).
     """
 
-    def __init__(self, send, max_keys=128, window_s=0.0, thread=True):
+    # extra slack past the read deadline before the waiter-side
+    # watchdog declares an in-flight batch stranded: the transport's
+    # own deadline sweep should have settled it long before this
+    WATCHDOG_GRACE_S = 1.0
+
+    def __init__(self, send, max_keys=128, window_s=0.0, thread=True,
+                 deadline_s=None):
         self._send_fn = send
         self.max_keys = max(1, int(max_keys))
         self.window_s = float(window_s)
+        self.deadline_s = deadline_s  # None = watchdog disabled
         self._lock = lockdep.lock("ReadBatcher._lock")
         self._wake = lockdep.condition("ReadBatcher._lock", self._lock)
         self._done_cond = lockdep.condition("ReadBatcher._done_cond")  # shared waiter parking
         self._queue = []  # [(op, future, span_ctx)]
+        self._inflight = None  # batch currently inside _send_fn
+        self._inflight_since = 0.0
+        self.stranded_settled = 0  # watchdog interventions (observability)
         self._closed = False
         self.batches_sent = 0
         self.ops_sent = 0
@@ -221,9 +241,44 @@ class ReadBatcher:
                 time.sleep(self.window_s)  # linger: let a window pile in
             self._flush_now()
 
+    def check_stranded(self):
+        """Waiter-side watchdog: a batch stuck inside ``_send_fn`` past
+        deadline + grace gets its futures settled retryably HERE, on
+        the waiting thread — a wedged send can strand the flusher
+        thread but never a caller. Settlement runs outside the queue
+        lock (the futures notify ``_done_cond``); the real send's
+        eventual settle attempts are no-ops (first settlement wins)."""
+        import time
+
+        if self.deadline_s is None:
+            return
+        bound = self.deadline_s + self.WATCHDOG_GRACE_S
+        with self._lock:
+            batch = self._inflight
+            if batch is None \
+                    or time.monotonic() - self._inflight_since < bound:
+                return
+            self._inflight = None  # claimed: exactly one waiter settles
+            self.stranded_settled += len(batch)
+        for _, fut, _ in batch:
+            fut.set_exception(err("process_behind"))
+
     def _send_batch(self, batch):
         """One multiplexed RPC for ``batch``; every member future
         settles here no matter how the send fails (FL002)."""
+        import time
+
+        with self._lock:
+            self._inflight = batch
+            self._inflight_since = time.monotonic()
+        try:
+            self._send_batch_inner(batch)
+        finally:
+            with self._lock:
+                if self._inflight is batch:
+                    self._inflight = None
+
+    def _send_batch_inner(self, batch):
         # the batch's span context: the FIRST sampled member's — the
         # server parents its storage.read_batch span to that trace
         # (the commit batcher's first_request_context idiom)

@@ -24,6 +24,7 @@ from foundationdb_tpu.txn import specialkeys
 from foundationdb_tpu.txn.futures import FutureRange, FutureValue
 from foundationdb_tpu.txn.rows import WriteMap
 from foundationdb_tpu.utils import span as span_mod
+from foundationdb_tpu.utils.backoff import Backoff
 
 _INVALID = object()
 
@@ -211,7 +212,14 @@ class Transaction:
         self._retry_limit = None
         self._max_retry_delay = knobs.max_retry_delay_s
         self._timeout_s = None
-        self._backoff = knobs.initial_backoff_s
+        # the unified retry-delay policy (utils/backoff.py — flow
+        # Backoff parity, jitter off the "backoff-jitter" stream); the
+        # OBJECT rides on_error's keep-tuple so growth survives resets
+        self._backoff = Backoff(
+            initial_s=knobs.initial_backoff_s,
+            max_s=knobs.max_retry_delay_s,
+            growth=knobs.backoff_growth,
+        )
         self._retries = 0
         self._size = 0
         self._special_writes = []  # buffered \xff\xff management writes
@@ -1123,9 +1131,10 @@ class Transaction:
             # no backoff owed, retry immediately (repair_ready decides
             # whether the body re-runs)
             return
-        delay = min(self._backoff, self._max_retry_delay)
-        time.sleep(delay)
-        self._backoff = self._backoff * self.db._knobs.backoff_growth
+        # set_max_retry_delay may have moved the cap after _reset built
+        # the policy: the option always wins (reference binding parity)
+        self._backoff.max_s = self._max_retry_delay
+        self._backoff.sleep()
         # timeout/retry_limit/max_retry_delay persist across resets, like
         # the reference binding (fdb_transaction_reset keeps those
         # options); the idempotency id persists too — the SAME id must
@@ -1185,10 +1194,14 @@ class _WatchHandle:
                 return True
             raise err("timed_out")
         start = time.monotonic()
+        # jittered growing poll (utils/backoff.py): a long-parked watch
+        # costs ~50 wakeups/s at first, decaying to ~50/s-worst-case
+        # 20ms polls — not a 1ms busy spin for its whole life
+        poller = Backoff(initial_s=poll, max_s=0.02, growth=1.5)
         while not self._watch.fired:
             if timeout is not None and time.monotonic() - start > timeout:
                 raise err("timed_out")
-            time.sleep(poll)
+            poller.sleep()
         return True
 
 

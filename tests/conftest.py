@@ -38,3 +38,16 @@ TEST_KNOBS = dict(
     coarse_buckets_bits=8,
     initial_backoff_s=0.0001,
 )
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_failure_monitor():
+    """The failure monitor is process-global (one per real process, by
+    design); in the one-process test suite that would leak one test's
+    failed endpoints into the next test's health verdict."""
+    from foundationdb_tpu.rpc import failuremon
+
+    failuremon.monitor().reset()
+    yield

@@ -52,6 +52,9 @@ def test_compact_summary_is_small_and_headline_last():
         # multi-region replication (ISSUE 14)
         "region_mode": "sync", "replication_lag_ms": 0.0,
         "region_failovers": 0,
+        # robustness stack (ISSUE 15): RPC deadline expiries, failed
+        # endpoints, and backoff sleeps taken — zeros must still ride
+        "rpc_timeouts": 0, "endpoints_failed": 0, "backoff_retries": 3,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -115,6 +118,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["region_mode"] == "sync"
     assert line["replication_lag_ms"] == 0.0
     assert line["region_failovers"] == 0
+    # the robustness counters ride the summary — a healthy run's zeros
+    # included, so a first nonzero is visible in the trajectory
+    assert line["rpc_timeouts"] == 0
+    assert line["endpoints_failed"] == 0
+    assert line["backoff_retries"] == 3
     assert line["configs"]["range"] == 390000.0
     assert line["configs"]["ring_capacity"] == 1.24
     assert line["configs"]["tpcc"] == "error"
@@ -210,7 +218,10 @@ def test_e2e_line_folds_proxies_and_platform():
                 # multi-region replication (ISSUE 14): every line says
                 # whether a satellite region rode along and what it cost
                 "region_mode", "replication_lag_ms",
-                "region_failovers"):
+                "region_failovers",
+                # robustness stack (ISSUE 15): deadline expiries, failed
+                # endpoints, backoff sleeps — snapshot-deltas per window
+                "rpc_timeouts", "endpoints_failed", "backoff_retries"):
         assert key in fields, key
     # regions default OFF: the gauges must say so explicitly
     assert fields["region_mode"] == "off"
@@ -220,6 +231,12 @@ def test_e2e_line_folds_proxies_and_platform():
     # healthy with an empty recovery timeline
     assert fields["health_verdict"] == "healthy"
     assert fields["recovery_count"] == 0
+    # in-process, fault-free: no deadline ever expired and no endpoint
+    # was ever marked failed (nonzero here would mean the robustness
+    # stack fired on a healthy run)
+    assert fields["rpc_timeouts"] == 0
+    assert fields["endpoints_failed"] == 0
+    assert fields["backoff_retries"] >= 0
     # in-process clusters resolve async reads inline (determinism), so
     # the batching gauges are exactly zero here — nonzero would mean
     # the sim-deterministic path started batching
@@ -480,6 +497,36 @@ def test_read_smoke_contract():
     assert out["read_ops"] > out["read_batches"] > 0
     assert out["read_batch_coalesce_rate"] > 1.0
     assert out["read_batch_p99"] > 1.0
+
+
+def test_chaos_smoke_contract():
+    """BENCH_MODE=chaos_smoke: the robustness-stack probe emits the
+    budget fields from the on/off RPC arms plus the chaos arm's
+    reproduction handle (seed + activated sites) and its invariant
+    verdict — and the invariants actually hold: every acked txn
+    survived, the counter matched the ack count, attempts stayed
+    deadline-bounded. One short round checks the contract; the bench
+    run owns the statistically serious comparison."""
+    out = bench.run_chaos_smoke(cpu=True, seconds=0.5, rounds=1,
+                                n_chaos_txns=8)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "robustness_overhead_pct", "overhead_budget_pct",
+                "within_budget", "chaos_seed", "chaos_sites",
+                "chaos_injections", "chaos_txns_acked",
+                "chaos_invariants_ok", "chaos_violations",
+                "rpc_timeouts", "endpoints_failed", "backoff_retries"):
+        assert key in out, key
+    assert out["metric"] == "e2e_chaos_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the correctness half is the point: zero acked loss, zero
+    # double-apply, deadline-bounded attempts — under REAL injected
+    # socket faults
+    assert out["chaos_invariants_ok"], out["chaos_violations"]
+    assert out["chaos_txns_acked"] == 8
+    # the injector stayed scoped to the probe
+    from foundationdb_tpu.rpc import chaos
+
+    assert not chaos.armed()
 
 
 def test_pack_smoke_contract():

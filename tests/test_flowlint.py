@@ -147,6 +147,87 @@ def test_fl001_seamed_scheduler_tiebreak_passes():
     assert findings == []
 
 
+def test_fl001_flags_manual_backoff_loop():
+    """A retry loop that sleeps a delay it grows by hand bypasses the
+    Backoff seam: unjittered (lockstep fleets) and off the seeded
+    'backoff-jitter' stream (ISSUE 15 satellite)."""
+    findings = lint("rpc/foo.py", """
+        import time
+
+        def call_with_retry(op):
+            delay = 0.01
+            while True:
+                try:
+                    return op()
+                except ConnectionError:
+                    time.sleep(delay)
+                    delay = min(1.0, delay * 2)
+    """)
+    assert rules_of(findings) == ["FL001"]
+    assert "manual backoff" in findings[0].message
+
+    findings = lint("server/foo.py", """
+        import time
+
+        def drain(rounds):
+            pause = 0.001
+            for _ in range(rounds):
+                time.sleep(pause)
+                pause *= 1.5
+    """)
+    assert rules_of(findings) == ["FL001"]
+
+
+def test_fl001_backoff_seam_and_fixed_sleeps_pass():
+    # the compliant twin: the same retry loop on the Backoff seam
+    findings = lint("rpc/foo.py", """
+        from foundationdb_tpu.utils.backoff import Backoff
+
+        def call_with_retry(op):
+            backoff = Backoff(initial_s=0.01, max_s=1.0)
+            while True:
+                try:
+                    return op()
+                except ConnectionError:
+                    backoff.sleep()
+    """)
+    assert findings == []
+
+    # a fixed-interval sleep in a loop is a cadence, not a backoff
+    findings = lint("server/foo.py", """
+        import time
+
+        def poll(stop):
+            while not stop.is_set():
+                time.sleep(0.05)
+    """)
+    assert findings == []
+
+    # growing a value the loop never sleeps isn't a backoff either
+    findings = lint("server/foo.py", """
+        import time
+
+        def scale(xs):
+            w = 1.0
+            for x in xs:
+                time.sleep(0.01)
+                w = w * 1.1
+                x.weight = w
+    """)
+    assert findings == []
+
+    # the seam itself keeps its grown-delay sleep
+    findings = lint("utils/backoff.py", """
+        import time
+
+        def sleep_loop(d):
+            while True:
+                time.sleep(d)
+                d = d * 2
+    """)
+    assert findings == []
+
+
 # ───────────────────────────── FL002 ─────────────────────────────
 def test_fl002_flags_risky_call_before_settlement():
     findings = lint("server/foo.py", """

@@ -182,6 +182,27 @@ def test_buggify_site_gating():
     assert {s: b1._sites[s] for s in sites} == {s: b2._sites[s] for s in sites}
 
 
+def test_buggify_activated_sites_same_seed_identical():
+    """The activated-site LIST is a pure function of the seed: two
+    same-seed instances touching the same sites report byte-identical
+    ``activated_sites()`` (the list a failing run's SimBuggifySites
+    trace prints must reproduce on the rerun), and a different seed
+    eventually picks a different subset."""
+    sites = [f"chaos.site{i}" for i in range(40)]
+
+    def activated(seed):
+        bg = Buggify(seed=seed, site_activated_p=0.5, fire_p=0.0)
+        for s in sites:
+            bg(s)
+        return bg.activated_sites()
+
+    assert activated(11) == activated(11)
+    assert activated(11) != activated(12), (
+        "40 sites at p=0.5 agreeing across seeds means activation "
+        "ignores the seed"
+    )
+
+
 @pytest.mark.parametrize("seed", [21, 22, 23])
 def test_api_correctness_under_faults(seed, tmp_path):
     """Randomized API transactions checked op-by-op against a model,

@@ -64,6 +64,67 @@ def test_worker_version_wait_and_future_version(lead):
         w.close()
 
 
+def test_wait_caught_up_raises_coded_retryable_errors(lead):
+    """wait_caught_up must NEVER surface a raw TimeoutError: a slow
+    bootstrap and a detached pull loop both answer with a retryable
+    coded FDBError (1037 process_behind), so a caller's standard
+    on_error loop owns the retry (ISSUE 15 satellite)."""
+    cluster, server, db = lead
+    db[b"k"] = b"v"
+    # never started: the caught-up event can't fire, so a short wait
+    # must convert to 1037 instead of TimeoutError
+    w = StorageWorker(server.address)
+    try:
+        with pytest.raises(FDBError) as ei:
+            w.wait_caught_up(timeout=0.05)
+        assert ei.value.code == 1037
+        assert ei.value.is_retryable
+        assert w.name in str(ei.value)
+    finally:
+        w.close()
+    # detached mid-bootstrap (lead address is a dead port): the pull
+    # loop exits, and the waiter gets a PROMPT coded error — not a
+    # full-timeout hang, not a raw exception type
+    host, _, port = server.address.rpartition(":")
+    dead = StorageWorker(f"{host}:1")  # port 1: connection refused
+    try:
+        dead.start()
+        t0 = time.monotonic()
+        with pytest.raises(FDBError) as ei:
+            dead.wait_caught_up(timeout=30.0)
+        assert ei.value.code == 1037
+        assert time.monotonic() - t0 < 10.0, (
+            "detach should fail the waiter promptly, not burn the "
+            "full timeout"
+        )
+        assert not dead.worker_status()["caught_up"]
+    finally:
+        dead.close()
+
+
+def test_worker_serves_ping(lead):
+    """Workers answer the keepalive probe the failure monitor's pinger
+    sends — a worker link must be health-checkable, not just the lead."""
+    cluster, server, db = lead
+    w = StorageWorker(server.address).start()
+    try:
+        w.wait_caught_up()
+        ws = w.serve()
+        try:
+            from foundationdb_tpu.rpc.transport import RpcClient
+
+            host, _, port = ws.address.rpartition(":")
+            c = RpcClient(host, int(port))
+            try:
+                assert c.call("ping") == "pong"
+            finally:
+                c.close()
+        finally:
+            ws.close()
+    finally:
+        w.close()
+
+
 def test_worker_survives_durability_pump(lead):
     """The pop-hold must keep log records alive until the worker applies
     them — even when the lead's durability pump runs aggressively."""
