@@ -28,6 +28,15 @@ class Knobs:
     # "auto" = on TPU backends, "on" = everywhere (interpreter off-TPU,
     # for differential tests), "off" = always the jnp lanes
     pallas_ring: str = "auto"
+    # mesh lane ownership (resolver/meshresolver.py, multi-lane tpu
+    # fleets only): "range" routes each packed entry host-side to the
+    # lane(s) owning its key range (resolver/packing.ShardRouter) and
+    # runs the compacted single-dispatch kernel — per-lane work shrinks
+    # ~1/n, the path that makes k lanes faster than one. "hash"
+    # replicates the batch and carves ownership in-kernel (hash-sharded
+    # point table, bucket-sharded ring): no host routing pass, no work
+    # reduction.
+    resolver_sharding: str = "range"
     # commit-path host packing (core/flatpack.py): "flat" = the client
     # pre-encodes conflict ranges into columnar limb blobs and the
     # proxy/packer consume them without per-txn Python ("legacy" keeps
@@ -43,15 +52,20 @@ class Knobs:
     # commit batch host-side — over the clients' already-encoded flat
     # limb blobs, before packing — so reads resolve before the writes
     # they overlap and the resolver sees fewer self-inflicted aborts.
-    # Default off: arrival order is the measured baseline.
-    commit_batch_scheduling: bool = False
+    # Default ON: the same-seed sim differential (tests/test_repair.py)
+    # proved byte-identical final state against the arrival-order
+    # baseline on both storage engines, so the reorder is free
+    # correctness-wise and strictly reduces in-batch aborts.
+    commit_batch_scheduling: bool = True
     # client-side transaction repair (txn/repair.py): on not_committed
     # with conflicting-key info, re-read ONLY the conflicting keys at
     # the failed batch's commit version and either replay the recorded
     # op log (read-set digest match — a spurious conflict) or fall back
-    # to the retry loop seeded with the verified read cache. Default
-    # off: the restart-from-scratch loop is the baseline.
-    txn_repair: bool = False
+    # to the retry loop seeded with the verified read cache. Default ON
+    # under the same differential as commit_batch_scheduling: repaired
+    # retries reach the identical final state the restart loop does,
+    # with fewer storage round trips per conflict.
+    txn_repair: bool = True
     # consecutive repair rounds before a conflicted transaction falls
     # back to the full cold restart (fresh GRV + backoff sleep) — the
     # livelock bound on the no-backoff repair retry

@@ -140,6 +140,51 @@ class ResolveBatch(NamedTuple):
     new_window_start: jnp.ndarray  # uint32[]
 
 
+class ShardBatch(NamedTuple):
+    """One commit batch COMPACTED per key-range lane — the presharded
+    single-dispatch layout (resolver/packing.py ShardRouter builds it).
+
+    Where ``ResolveBatch`` keeps a dense ``[T, K]`` slot grid per
+    conflict side, this layout pools each side into a flat slot array of
+    per-lane capacity Q with an explicit owning-txn index: the host
+    router sends each entry ONLY to the lane(s) whose key range it
+    touches, so per-lane work shrinks as the lane count grows (the dense
+    layout replicates every entry to every lane and shrinks nothing).
+    Point entries go to exactly ``lane(key)``; range entries get one
+    slot in EVERY lane their span overlaps, carrying the FULL unclipped
+    range (the overlap checks stay exact; duplicates only re-derive the
+    same verdict). ``rv``/``txn_mask``/``cv``/``new_window_start`` stay
+    replicated — the verdict fold needs them on every lane.
+    """
+
+    rv: jnp.ndarray  # uint32[T] read-version offsets (replicated)
+    txn_mask: jnp.ndarray  # bool[T] (replicated)
+    pr_hash: jnp.ndarray  # uint32[Qpr]
+    pr_key: jnp.ndarray  # uint32[Qpr, W]
+    pr_bucket: jnp.ndarray  # int32[Qpr]
+    pr_txn: jnp.ndarray  # int32[Qpr] owning txn slot in [0, T)
+    pr_mask: jnp.ndarray  # bool[Qpr]
+    pw_hash: jnp.ndarray  # uint32[Qpw]
+    pw_key: jnp.ndarray  # uint32[Qpw, W]
+    pw_bucket: jnp.ndarray  # int32[Qpw]
+    pw_txn: jnp.ndarray  # int32[Qpw]
+    pw_mask: jnp.ndarray  # bool[Qpw]
+    rr_b: jnp.ndarray  # uint32[Qrr, W]
+    rr_e: jnp.ndarray  # uint32[Qrr, W]
+    rr_lo: jnp.ndarray  # int32[Qrr]
+    rr_hi: jnp.ndarray  # int32[Qrr]
+    rr_txn: jnp.ndarray  # int32[Qrr]
+    rr_mask: jnp.ndarray  # bool[Qrr]
+    rw_b: jnp.ndarray  # uint32[Qrw, W]
+    rw_e: jnp.ndarray  # uint32[Qrw, W]
+    rw_lo: jnp.ndarray  # int32[Qrw]
+    rw_hi: jnp.ndarray  # int32[Qrw]
+    rw_txn: jnp.ndarray  # int32[Qrw]
+    rw_mask: jnp.ndarray  # bool[Qrw]
+    cv: jnp.ndarray  # uint32[] commit-version offset (replicated)
+    new_window_start: jnp.ndarray  # uint32[] (replicated)
+
+
 from foundationdb_tpu.core.status import COMMITTED, CONFLICT, TOO_OLD  # noqa: E402
 
 
@@ -452,6 +497,10 @@ def resolve_batch(
     O &= strict_lower & batch.txn_mask[:, None] & batch.txn_mask[None, :]
 
     # ───────────────── Jacobi fixpoint for sequential acceptance ───────────
+    # The kill vector is psum-reduced per iteration rather than OR-folding
+    # the whole [T,T] matrix up front: d small [T] reductions measure
+    # cheaper than one [T,T] all-reduce for the shallow conflict chains
+    # real batches carry (d is the chain depth, typically 1-3).
     a0 = (~too_old) & (~hist) & batch.txn_mask
     Of = O.astype(jnp.bfloat16)
 
@@ -615,6 +664,268 @@ def validate_params(params: ResolverParams):
                 "ring layout (silently ignoring the explicit pallas "
                 "request would misattribute benchmarks)"
             )
+
+
+def resolve_batch_presharded(
+    state: ResolverState,
+    sb: ShardBatch,
+    params: ResolverParams,
+    axis_name=None,
+):
+    """The compacted-lane resolver step (single-dispatch sharded path).
+
+    Semantics match ``resolve_batch``'s sharded mode, but ownership is
+    established HOST-side by the router instead of in-kernel masks: each
+    lane sees only the entries whose keys it owns, so the dominant cost
+    terms — the [Q, KR] ring scan and the [Qw, Qr] pairwise matrix —
+    shrink with the lane count instead of being replicated n times.
+
+    Correctness rests on the routing invariants (ShardBatch docstring):
+    any read/write pair that overlaps shares a key point p, and both
+    entries are routed to lane(p), so every conflict is checked on at
+    least one lane; ``por``/psum folds the per-lane partials. Per-lane
+    scalars (``rv``, ``txn_mask``, ``cv``, window) are replicated, so
+    ``too_old``/``status``/``accepted`` come out replicated — the proxy
+    reads ONE verdict vector.
+    """
+    T = params.txns
+    u32 = jnp.uint32
+    rv = sb.rv  # [T]
+    Qpr = sb.pr_key.shape[0]
+    Qpw = sb.pw_key.shape[0]
+    Qrr = sb.rr_b.shape[0]
+    Qrw = sb.rw_b.shape[0]
+
+    if axis_name is None:
+
+        def por(x):
+            return x
+
+        def pmax_arr(x):
+            return x
+
+    else:
+        names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+
+        def por(x):
+            return jax.lax.psum(x.astype(jnp.int32), names) > 0
+
+        def pmax_arr(x):
+            return jax.lax.pmax(x, names)
+
+    # ───────────────────────── history conflicts ─────────────────────────
+    too_old = rv < state.window_start
+
+    # per-txn hit counts accumulate by scatter-ADD (a bool scatter-max is
+    # not portably lowered); padding slots point at txn 0 with mask False
+    # so they add zero
+    hist_i = jnp.zeros((T,), jnp.int32)
+
+    if params.range_writes:
+        pref_L = jax.lax.associative_scan(jnp.maximum, state.range_L)
+        suf_R = jax.lax.associative_scan(jnp.maximum, state.range_R, reverse=True)
+
+    if Qpr:
+        rv_q = rv[sb.pr_txn]  # [Qpr]
+        hit = (
+            state.ht[sb.pr_hash & u32((1 << params.hash_bits) - 1)] > rv_q
+        ) & sb.pr_mask
+        if params.range_writes:
+            in_rng = _point_in(
+                sb.pr_key[:, None, :], state.ring_b[None], state.ring_e[None]
+            )  # [Qpr, KR]
+            newer = (state.ring_v[None] > rv_q[:, None]) & state.ring_mask[None]
+            hit |= jnp.any(in_rng & newer, axis=1) & sb.pr_mask
+            coarse = jnp.minimum(pref_L[sb.pr_bucket], suf_R[sb.pr_bucket])
+            hit |= (coarse > rv_q) & sb.pr_mask
+        hist_i = hist_i.at[sb.pr_txn].add(
+            hit.astype(jnp.int32), mode="promise_in_bounds"
+        )
+
+    if Qrr:
+        rv_q = rv[sb.rr_txn]  # [Qrr]
+        hit = jnp.zeros((Qrr,), bool)
+        if params.range_writes:
+            ov = ranges_overlap(
+                sb.rr_b[:, None, :], sb.rr_e[:, None, :],
+                state.ring_b[None], state.ring_e[None],
+            )  # [Qrr, KR]
+            newer = (state.ring_v[None] > rv_q[:, None]) & state.ring_mask[None]
+            hit |= jnp.any(ov & newer, axis=1) & sb.rr_mask
+            coarse_rng = jnp.minimum(pref_L[sb.rr_hi], suf_R[sb.rr_lo])
+            hit |= (coarse_rng > rv_q) & sb.rr_mask
+        if params.point_writes:
+            levels = _sparse_table(state.point_coarse)
+            pmax = _range_max(levels, sb.rr_lo, sb.rr_hi)
+            hit |= (pmax > rv_q) & sb.rr_mask
+        hist_i = hist_i.at[sb.rr_txn].add(
+            hit.astype(jnp.int32), mode="promise_in_bounds"
+        )
+
+    hist = por(hist_i > 0)
+
+    # ─────────────────────── intra-batch conflict matrix ───────────────────
+    # O[t1, t2] accumulates by 2-D scatter-add over (write_txn, read_txn)
+    # pairs; cross-lane duplicates (a spanning write × spanning read seen
+    # on two lanes) just add twice before the >0 threshold.
+    O_i = jnp.zeros((T, T), jnp.int32)
+    if Qpw and Qpr:
+        wh = jnp.where(sb.pw_mask, sb.pw_hash, u32(0xFFFFFFFF))
+        rh = jnp.where(sb.pr_mask, sb.pr_hash, u32(0xFFFFFFFE))
+        eq = wh[:, None] == rh[None, :]  # [Qpw, Qpr]
+        O_i = O_i.at[sb.pw_txn[:, None], sb.pr_txn[None, :]].add(
+            eq.astype(jnp.int32), mode="promise_in_bounds"
+        )
+    if Qpw and Qrr:
+        inr = _point_in(
+            sb.pw_key[:, None, :], sb.rr_b[None], sb.rr_e[None]
+        )  # [Qpw, Qrr]
+        m = sb.pw_mask[:, None] & sb.rr_mask[None, :]
+        O_i = O_i.at[sb.pw_txn[:, None], sb.rr_txn[None, :]].add(
+            (inr & m).astype(jnp.int32), mode="promise_in_bounds"
+        )
+    if Qrw and Qpr:
+        inr = _point_in(
+            sb.pr_key[None], sb.rw_b[:, None, :], sb.rw_e[:, None, :]
+        )  # [Qrw, Qpr]
+        m = sb.rw_mask[:, None] & sb.pr_mask[None, :]
+        O_i = O_i.at[sb.rw_txn[:, None], sb.pr_txn[None, :]].add(
+            (inr & m).astype(jnp.int32), mode="promise_in_bounds"
+        )
+    if Qrw and Qrr:
+        ov = ranges_overlap(
+            sb.rr_b[None], sb.rr_e[None],
+            sb.rw_b[:, None, :], sb.rw_e[:, None, :],
+        )  # [Qrw, Qrr]
+        m = sb.rw_mask[:, None] & sb.rr_mask[None, :]
+        O_i = O_i.at[sb.rw_txn[:, None], sb.rr_txn[None, :]].add(
+            (ov & m).astype(jnp.int32), mode="promise_in_bounds"
+        )
+
+    strict_lower = jnp.tril(jnp.ones((T, T), bool), k=-1).T  # [t1 < t2]
+    O = (O_i > 0) & strict_lower & sb.txn_mask[:, None] & sb.txn_mask[None, :]
+
+    # Jacobi fixpoint — identical to resolve_batch: the kill vector is
+    # psum-reduced per iteration (d small [T] reductions beat one [T,T]
+    # all-reduce for the shallow chains real batches carry)
+    a0 = (~too_old) & (~hist) & sb.txn_mask
+    Of = O.astype(jnp.bfloat16)
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        a, _ = carry
+        killed_local = jnp.dot(
+            a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32
+        )
+        if axis_name is not None:
+            killed_local = jax.lax.psum(killed_local, axis_name)
+        killed = killed_local > 0.5
+        a_new = a0 & ~killed
+        return a_new, jnp.any(a_new != a)
+
+    accepted, _ = jax.lax.while_loop(cond, body, (a0, jnp.array(True)))
+
+    status = jnp.where(too_old, TOO_OLD, jnp.where(accepted, COMMITTED, CONFLICT))
+    status = jnp.where(sb.txn_mask, status, CONFLICT)
+
+    # ───────────────────────── history update ─────────────────────────────
+    cv = sb.cv
+    ht = state.ht
+    point_coarse = state.point_coarse
+    if Qpw:
+        ok = sb.pw_mask & accepted[sb.pw_txn]  # [Qpw]
+        ht = ht.at[sb.pw_hash & u32((1 << params.hash_bits) - 1)].max(
+            jnp.where(ok, cv, u32(0)), mode="promise_in_bounds"
+        )
+        if params.range_reads or params.record_point_coarse:
+            # unlike the dense sharded path (where every lane applies the
+            # identical replicated update), lanes here record DIFFERENT
+            # subsets — the replicated summary needs an explicit pmax
+            point_coarse = point_coarse.at[
+                jnp.clip(sb.pw_bucket, 0, point_coarse.shape[0] - 1)
+            ].max(jnp.where(ok, cv, u32(0)))
+            point_coarse = pmax_arr(point_coarse)
+
+    ring_b, ring_e, ring_v = state.ring_b, state.ring_e, state.ring_v
+    ring_lo, ring_hi, ring_mask = state.ring_lo, state.ring_hi, state.ring_mask
+    ring_head = state.ring_head
+    range_L, range_R = state.range_L, state.range_R
+    if Qrw:
+        kr = ring_v.shape[0]
+        ok = sb.rw_mask & accepted[sb.rw_txn]  # [Qrw]
+        slot_order = jnp.cumsum(ok) - 1
+        # a skewed split can exceed the per-lane ring in one batch (the
+        # dense path's T*RW <= KR invariant is per-lane Q-dependent
+        # here): overflowing entries fold conservatively into the coarse
+        # interval summaries — the same direction as eviction
+        ok_ring = ok & (slot_order < kr)
+        overflow = ok & (slot_order >= kr)
+        pos = jnp.where(ok_ring, (ring_head + slot_order) % kr, kr)
+        new_head = (
+            (ring_head + jnp.minimum(jnp.sum(ok), kr)) % kr
+        ).astype(jnp.int32)
+        o_val = jnp.where(overflow, cv, u32(0))
+        range_L = range_L.at[
+            jnp.clip(sb.rw_lo, 0, range_L.shape[0] - 1)
+        ].max(o_val)
+        range_R = range_R.at[
+            jnp.clip(sb.rw_hi, 0, range_R.shape[0] - 1)
+        ].max(o_val)
+        # fold evicted entries into the coarse interval summary first
+        will_evict = jnp.zeros((kr,), bool).at[pos].set(True, mode="drop")
+        evict = will_evict & ring_mask
+        ev_val = jnp.where(evict, ring_v, u32(0))
+        range_L = range_L.at[jnp.clip(ring_lo, 0, range_L.shape[0] - 1)].max(ev_val)
+        range_R = range_R.at[jnp.clip(ring_hi, 0, range_R.shape[0] - 1)].max(ev_val)
+        ring_b = ring_b.at[pos].set(sb.rw_b, mode="drop")
+        ring_e = ring_e.at[pos].set(sb.rw_e, mode="drop")
+        ring_v = ring_v.at[pos].set(jnp.where(ok_ring, cv, u32(0)), mode="drop")
+        ring_lo = ring_lo.at[pos].set(sb.rw_lo, mode="drop")
+        ring_hi = ring_hi.at[pos].set(sb.rw_hi, mode="drop")
+        ring_mask = ring_mask.at[pos].set(ok_ring, mode="drop")
+        ring_head = new_head
+        # folds target arbitrary buckets; sync the replicated summaries
+        range_L = pmax_arr(range_L)
+        range_R = pmax_arr(range_R)
+
+    new_state = ResolverState(
+        window_start=jnp.maximum(state.window_start, sb.new_window_start),
+        ht=ht,
+        ring_b=ring_b,
+        ring_e=ring_e,
+        ring_v=ring_v,
+        ring_lo=ring_lo,
+        ring_hi=ring_hi,
+        ring_mask=ring_mask,
+        ring_head=ring_head,
+        range_L=range_L,
+        range_R=range_R,
+        point_coarse=point_coarse,
+    )
+    return status, accepted, new_state
+
+
+def validate_presharded_params(params: ResolverParams):
+    """Invariants of the compacted-lane path. The dense path's
+    T*RW <= KR wrap check does not apply: the kernel detects per-lane
+    ring overflow at trace shapes and folds the excess into the coarse
+    summaries instead of wrapping."""
+    if params.use_pallas:
+        raise ValueError(
+            "presharded resolve has no Pallas ring lane: the VMEM kernel "
+            "implements the dense [T, K] layout (silently ignoring the "
+            "explicit pallas request would misattribute benchmarks)"
+        )
+    if params.ring_partition_bits:
+        raise ValueError(
+            "ring_partition_bits is a single-device layout; the presharded "
+            "path shards the ring across lanes instead"
+        )
+    if params.bucket_bits > 30 or params.hash_bits > 28:
+        raise ValueError("bucket_bits/hash_bits unreasonably large")
 
 
 def make_resolve_fn(params: ResolverParams, donate=True):

@@ -75,6 +75,25 @@ def _batch_specs():
     return jax.tree.map(lambda _: P(), ck.ResolveBatch(*ck.ResolveBatch._fields))
 
 
+# ShardBatch fields that stay replicated across lanes (everything else
+# is a per-lane compacted slot array, sharded on its leading axis)
+_SHARD_REPLICATED = {"rv", "txn_mask", "cv", "new_window_start"}
+
+
+def _shard_batch_specs(axes=AXIS, scan=False):
+    """PartitionSpecs for a ShardBatch: entry slot arrays split on the
+    lane axis (leading dim n*Q → per-lane Q), verdict-fold inputs
+    replicated. ``scan=True`` shifts the lane axis behind the batch
+    axis (stacked [B, n*Q, ...] inputs for the scan path)."""
+
+    def spec(name):
+        if name in _SHARD_REPLICATED:
+            return P()
+        return P(None, axes) if scan else P(axes)
+
+    return ck.ShardBatch(*(spec(f) for f in ck.ShardBatch._fields))
+
+
 class ShardedResolverKernel:
     """The resolver fleet as one SPMD program.
 
@@ -156,3 +175,56 @@ class ShardedResolverKernel:
         device for B consecutive commit batches. Returns statuses[B, T]."""
         self.state, statuses = self._scan_step(self.state, batches)
         return statuses
+
+
+class PreshardedResolverKernel(ShardedResolverKernel):
+    """The compacted-lane fleet: one SPMD program over host-presharded
+    ShardBatches (ops/conflict.resolve_batch_presharded).
+
+    The dense ``ShardedResolverKernel`` replicates the whole batch to
+    every lane and carves ownership in-kernel — per-lane work never
+    shrinks, so k lanes cost k× the FLOPs of one. Here the host router
+    (resolver/packing.ShardRouter) sends each entry only to the lane(s)
+    owning its keys, so the ring scan and the pairwise conflict matrix
+    shrink ~1/n per lane while history capacity still scales n×. State
+    layout and placement are inherited unchanged (``ring_capacity`` is
+    the PER-LANE ring size, as before); only the batch specs and the
+    kernel body differ. Ref: CommitProxyServer.actor.cpp's resolution
+    fan-out, collapsed into one collective dispatch.
+    """
+
+    def __init__(self, params: ck.ResolverParams, mesh=None, donate=True,
+                 make_state=True):
+        ck.validate_presharded_params(params)
+        self.params = params
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.n = self.mesh.devices.size
+        self.axes = tuple(self.mesh.axis_names)
+        self.spec_axes = self.axes if len(self.axes) > 1 else self.axes[0]
+
+        fn = functools.partial(
+            ck.resolve_batch_presharded, params=params,
+            axis_name=self.spec_axes,
+        )
+        sharded = _shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(_state_specs(self.spec_axes),
+                      _shard_batch_specs(self.spec_axes)),
+            out_specs=(P(), P(), _state_specs(self.spec_axes)),
+            **{_CHECK_KW: False},
+        )
+        self._step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+        scan_sharded = _shard_map(
+            ck.scan_of(fn),
+            mesh=self.mesh,
+            in_specs=(_state_specs(self.spec_axes),
+                      _shard_batch_specs(self.spec_axes, scan=True)),
+            out_specs=(_state_specs(self.spec_axes), P()),
+            **{_CHECK_KW: False},
+        )
+        self._scan_step = jax.jit(
+            scan_sharded, donate_argnums=(0,) if donate else ()
+        )
+        self.state = self.init_state() if make_state else None

@@ -12,10 +12,20 @@ the conflict history (hash-sharded point table, bucket-sharded range
 ring), the batch is replicated, and verdicts combine with psum over ICI
 — no host fan-out, no clipped sub-batches, ONE dispatch per batch.
 
-Because the sharding is hash/bucket based (not key-range), there are no
-resolver boundaries to re-derive from the data distribution and no
-fencing rebuilds when shards move — the coordination problem the
-reference's keyResolvers map exists to solve disappears.
+Two lane-ownership schemes, selected by ``knobs.resolver_sharding``:
+
+- ``"range"`` (default): the host routes each already-encoded entry to
+  the lane(s) owning its key range (resolver/packing.ShardRouter — a
+  vectorized cumsum pass over the packed arrays, no TxnRequest decode)
+  and the device runs the COMPACTED per-lane slots
+  (ops/conflict.resolve_batch_presharded). Per-lane scan and pairwise
+  work shrink ~1/n — the path that makes k lanes faster than one.
+- ``"hash"``: the batch is replicated and each lane carves ownership
+  in-kernel (hash-sharded point table, bucket-sharded ring). No host
+  routing pass, but per-lane work never shrinks. No resolver
+  boundaries to re-derive from the data distribution — the coordination
+  problem the reference's keyResolvers map exists to solve disappears —
+  at the price of k× replicated FLOPs.
 
 `Cluster(n_resolvers=k, resolver_backend="tpu")` constructs one
 MeshResolver over a k-lane mesh (clamped to the devices present; a
@@ -26,9 +36,10 @@ whole mesh under `lax.scan`.
 """
 
 import jax
+import numpy as np
 
 from foundationdb_tpu.core.options import DEFAULT_KNOBS
-from foundationdb_tpu.resolver.packing import BatchPacker
+from foundationdb_tpu.resolver.packing import BatchPacker, ShardRouter
 from foundationdb_tpu.resolver.resolver import (
     BACKLOG_B,
     Resolver,
@@ -52,6 +63,7 @@ class MeshResolver(Resolver):
     def __init__(self, knobs=DEFAULT_KNOBS, base_version=0, n_lanes=None,
                  mesh=None):
         from foundationdb_tpu.parallel.mesh import (
+            PreshardedResolverKernel,
             ShardedResolverKernel,
             default_mesh,
         )
@@ -92,24 +104,43 @@ class MeshResolver(Resolver):
             ring_partition_bits=0
         )
         self.packer = BatchPacker(self.params)
-        self._kernel = ShardedResolverKernel(self.params, mesh=self.mesh)
-        self.state = self._kernel.state
-        self._kernel.state = None  # ownership moves here (donated per step)
-        self._resolve = self._kernel._step
-        # point-specialized fast variant (see Resolver.__init__): same
-        # state, range lanes statically off. make_state=False — the twin
-        # kernel shares THIS resolver's state arrays.
+        # "range" (the default) is the single-dispatch compacted path:
+        # the host routes each entry to the lane(s) owning its keys
+        # (ShardRouter), so per-lane scan/pairwise work shrinks ~1/n.
+        # "hash" is the replicated-batch path (in-kernel hash/bucket
+        # ownership): no per-lane work reduction, but no host routing
+        # pass either — the latency-floor choice for tiny fleets.
+        self.sharding = getattr(knobs, "resolver_sharding", "range")
         self._fast = None
-        self._fast_params = fast_params_of(self.params)
+        self._fast_params = None
         self._fast_kernel = None
         self._range_history = False
-        if self._fast_params is not None:
-            self._fast_kernel = ShardedResolverKernel(
-                self._fast_params, mesh=self.mesh, make_state=False
-            )
-            self._fast = (
-                BatchPacker(self._fast_params), self._fast_kernel._step
-            )
+        if self.sharding == "range":
+            self._kernel = PreshardedResolverKernel(self.params,
+                                                    mesh=self.mesh)
+            self._router = ShardRouter(self.params, self.n_lanes)
+            self._resolve = self._route_step
+            # no point-specialized twin: the compacted layout already
+            # skips dead sides per-entry, and a second compiled variant
+            # would double the routing/compile surface for little win
+        else:
+            self._kernel = ShardedResolverKernel(self.params,
+                                                 mesh=self.mesh)
+            self._router = None
+            self._resolve = self._kernel._step
+            # point-specialized fast variant (see Resolver.__init__):
+            # same state, range lanes statically off. make_state=False —
+            # the twin kernel shares THIS resolver's state arrays.
+            self._fast_params = fast_params_of(self.params)
+            if self._fast_params is not None:
+                self._fast_kernel = ShardedResolverKernel(
+                    self._fast_params, mesh=self.mesh, make_state=False
+                )
+                self._fast = (
+                    BatchPacker(self._fast_params), self._fast_kernel._step
+                )
+        self.state = self._kernel.state
+        self._kernel.state = None  # ownership moves here (donated per step)
         self._scan_fns = {}
         self._scan_pad_buckets = (
             (2, 4, BACKLOG_B)
@@ -117,7 +148,47 @@ class MeshResolver(Resolver):
         )
         self.adopt_profile(self.profile)  # attach the packer hooks
 
+    def _split_counted(self, stacked):
+        """Route a stacked numpy ResolveBatch through the ShardRouter,
+        recording per-lane ENTRY COUNTS as the lane-balance instrument
+        (host-side, FL004-clean). The counts feed the same lane_skew_pct
+        rollup the hash path fills with per-lane walls — in range mode
+        the split balance IS the utilization story, and it is known
+        before the device ever runs."""
+        sb, k, lane_counts = self._router.split(stacked)
+        if deviceprofile.enabled():
+            self.profile.record_lane_counts(lane_counts.tolist())
+        return sb, k
+
+    def _route_step(self, state, batch):
+        """Single-batch presharded step behind the ``self._resolve``
+        signature: (state, numpy ResolveBatch) → (status, accepted,
+        state). Accepted is not materialized separately (the status
+        vector already encodes it; _step_kernel only reads status)."""
+        stacked = jax.tree.map(lambda a: np.asarray(a)[None], batch)
+        sb, k = self._split_counted(stacked)
+        if k == 1:
+            single = jax.tree.map(lambda a: a[0], sb)
+            status, accepted, state = self._kernel._step(state, single)
+            return status, accepted, state
+        # rare over-capacity skew: the batch rides the scan as k slices
+        state, st = self._kernel._scan_step(state, sb)
+        status = self._router.reassemble(st, k)[0]
+        return status, None, state
+
     def _make_scan_fn(self, use_fast):
+        if self.sharding == "range":
+            kern = self._kernel
+            router = self._router
+
+            def routed_scan(state, stacked):
+                sb, k = self._split_counted(stacked)
+                state, st = kern._scan_step(state, sb)
+                if k > 1:
+                    st = router.reassemble(st, k)
+                return state, st
+
+            return routed_scan
         kernel = self._fast_kernel if use_fast else self._kernel
         return kernel._scan_step
 
@@ -128,8 +199,12 @@ class MeshResolver(Resolver):
         copy: blocking each lane's shard in stable device order and
         timestamping its completion gives per-lane walls host-side —
         a straggler lane stretches its entry, balanced lanes land
-        together. HOST-side only (materialize time, FL004-clean)."""
-        if not deviceprofile.enabled():
+        together. HOST-side only (materialize time, FL004-clean).
+
+        Range mode records per-lane ENTRY COUNTS at split time instead
+        (_split_counted) — one instrument per mode, never mixed units in
+        the same rollup."""
+        if self.sharding == "range" or not deviceprofile.enabled():
             return
         from foundationdb_tpu.parallel.mesh import lane_shards
 
@@ -142,6 +217,11 @@ class MeshResolver(Resolver):
             s.data.block_until_ready()
             walls.append(deviceprofile.now() - t0)
         self.profile.record_lanes(walls)
+
+    def status(self):
+        doc = super().status()
+        doc["sharding"] = self.sharding
+        return doc
 
     def respawn(self, base_version):
         """Recruitment: a fresh fleet on the same mesh, fenced (the

@@ -451,6 +451,16 @@ class BatchingCommitProxy:
         out["sched_batches"] = getattr(inner, "sched_batches", 0)
         out["sched_reordered"] = getattr(inner, "sched_reordered_total", 0)
         out["sched_deferred"] = getattr(inner, "sched_deferred_total", 0)
+        # which resolve path served this run: "range" (single-dispatch
+        # presharded mesh), "hash" (replicated-batch mesh), or "local"
+        # (single-lane / host fan-out) — so a bench line always states
+        # the path behind its lane_skew_pct numbers
+        resolvers = getattr(inner, "resolvers", ())
+        out["resolver_sharding"] = next(
+            (r.sharding for r in resolvers if hasattr(r, "sharding")),
+            "local")
+        out["resolver_lanes"] = sum(
+            getattr(r, "n_lanes", 1) for r in resolvers)
         out["pack_bytes"] = round(
             getattr(inner, "pack_bytes_total", 0) / max(flat, 1)
         )

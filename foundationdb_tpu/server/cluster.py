@@ -367,6 +367,7 @@ class Cluster:
             change_feeds=self.change_feeds,
             resolve_gate=resolve_gate, log_gate=log_gate,
             regions=getattr(self, "regions", None),
+            fanout_profile=self._role_profile(0),
             metrics=self._role_registry("commit_proxy", index),
             heatmap=(
                 self._role_heatmap("commit_proxy", index,
@@ -1585,6 +1586,10 @@ class Cluster:
                         {"id": i, "alive": r.alive,
                          "backend": self.knobs.resolver_backend,
                          "lanes": getattr(r, "n_lanes", 1),
+                         # "range" = single-dispatch presharded mesh,
+                         # "hash" = replicated-batch mesh, "local" =
+                         # single-lane / host resolvers
+                         "sharding": getattr(r, "sharding", "local"),
                          "metrics": r.metrics.snapshot()}
                         for i, r in enumerate(self.resolvers)
                     ],

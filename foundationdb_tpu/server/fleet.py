@@ -164,9 +164,12 @@ class ProxyFleet:
             vals = [s[k] for s in sums]
             if k == "pipeline_depth":
                 out[k] = max(vals)
-            elif k == "pack_path":
-                # the members' dominant path; "mixed" when they differ
+            elif k in ("pack_path", "resolver_sharding"):
+                # the members' dominant value; "mixed" when they differ
                 out[k] = vals[0] if len(set(vals)) == 1 else "mixed"
+            elif k == "resolver_lanes":
+                # every member fronts the same resolver fleet
+                out[k] = max(vals)
             elif k in ("pack_flat_batches", "pack_legacy_batches"):
                 out[k] = sum(vals)
             else:

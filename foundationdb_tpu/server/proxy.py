@@ -20,6 +20,7 @@ from foundationdb_tpu.resolver.resolver import ResolverDown
 from foundationdb_tpu.resolver.skiplist import TxnRequest
 from foundationdb_tpu.server.sequencer import SequencerDown
 from foundationdb_tpu.server.tlog import TLogDown
+from foundationdb_tpu.utils import deviceprofile
 from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
@@ -101,8 +102,15 @@ class CommitProxy:
     def __init__(self, sequencer, resolvers, tlog, storages, knobs,
                  ratekeeper=None, dd=None, change_feeds=None,
                  resolve_gate=None, log_gate=None, metrics=None,
-                 heatmap=None, regions=None):
+                 heatmap=None, regions=None, fanout_profile=None):
         self.alive = True
+        # lane-balance instrument for the legacy host fan-out (clip
+        # loop below): per-sub-batch entry counts feed the same
+        # lane_skew_pct rollup the mesh router fills at split time.
+        # The cluster hands its resolver-0 DeviceProfile so the counts
+        # land in the standard device doc even for host (cpu/native)
+        # resolver fleets, which carry no profile of their own.
+        self._fanout_profile = fanout_profile
         # multi-region replication (server/region.py RegionReplicator):
         # in sync satellite mode the finalize tail pushes each batch to
         # the remote region BEFORE acknowledging it. The cluster swaps
@@ -1404,6 +1412,23 @@ class CommitProxy:
                 )
                 for t in txns
             ])
+        # lane balance on the host fan-out, same instrument the mesh
+        # router fills at split time: surviving conflict entries per
+        # clipped sub-batch -> lane_skew_pct. The tpu multi-lane backend
+        # never reaches here (Cluster builds ONE MeshResolver; its
+        # single-dispatch router retires this clip loop), so this covers
+        # the cpu/native fleets for before/after skew comparison.
+        if deviceprofile.enabled():
+            prof = self._fanout_profile or next(
+                (r.profile for r in self.resolvers
+                 if getattr(r, "profile", None) is not None), None)
+            if prof is not None:
+                prof.record_lane_counts([
+                    sum(len(t.point_reads) + len(t.point_writes)
+                        + len(t.range_reads) + len(t.range_writes)
+                        for t in batch)
+                    for batch in shard_batches
+                ])
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 

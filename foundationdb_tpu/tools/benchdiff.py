@@ -61,6 +61,10 @@ HIGHER_BETTER = (
     # batch-size percentiles mean better coalescing ("read_batch_p99_ms"
     # — the serve latency — still resolves lower-better via "_ms" above)
     "coalesce_rate", "read_batch_p",
+    # sharded resolve (ISSUE 16): the summary metric's value is
+    # resolved txns/sec — more is better ("sharded_speedup" and
+    # "lane_skew_pct" already resolve via "speedup" / "lane_skew")
+    "shard_smoke",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

@@ -100,8 +100,13 @@ class DeviceProfile:
         # walls (deterministic clock; 0.0 under the sim step clock)
         self.dispatch_wall_s = 0.0
         self.verdict_reduce_wall_s = 0.0
-        # mesh lanes: accumulated per-lane dispatch wall
+        # mesh lanes: accumulated per-lane dispatch wall (hash-sharded
+        # mode / legacy host fan-out) OR per-lane routed-entry counts
+        # (range-sharded mode — known at split time, before the device
+        # runs). One profile only ever fills one of the two: skew over
+        # mixed units would be meaningless.
         self.lane_walls_s = []
+        self.lane_entries = []
         self.lane_dispatches = 0
         # fallback-cause taxonomy
         self.fallback_causes = {c: 0 for c in FALLBACK_CAUSES}
@@ -167,6 +172,21 @@ class DeviceProfile:
                 self.lane_walls_s[i] += float(w)
             self.lane_dispatches += 1
 
+    def record_lane_counts(self, counts):
+        """Per-lane routed-entry counts for ONE dispatch (range-sharded
+        mesh: the ShardRouter split, or the legacy proxy fan-out's
+        clipped sub-batches). Same lane_skew_pct rollup as the wall
+        instrument — balance in entries instead of seconds."""
+        if not _enabled:
+            return
+        with self._lock:
+            if len(self.lane_entries) < len(counts):
+                self.lane_entries.extend(
+                    0 for _ in range(len(counts) - len(self.lane_entries)))
+            for i, c in enumerate(counts):
+                self.lane_entries[i] += int(c)
+            self.lane_dispatches += 1
+
     def record_verdict_reduce(self, wall_s):
         if not _enabled:
             return
@@ -197,6 +217,7 @@ class DeviceProfile:
                 "dispatch_wall_s": other.dispatch_wall_s,
                 "verdict_reduce_wall_s": other.verdict_reduce_wall_s,
                 "lane_walls_s": list(other.lane_walls_s),
+                "lane_entries": list(other.lane_entries),
                 "lane_dispatches": other.lane_dispatches,
                 "fallback_causes": dict(other.fallback_causes),
             }
@@ -226,6 +247,12 @@ class DeviceProfile:
                                        - len(self.lane_walls_s)))
             for i, w in enumerate(o["lane_walls_s"]):
                 self.lane_walls_s[i] += w
+            if len(self.lane_entries) < len(o["lane_entries"]):
+                self.lane_entries.extend(
+                    0 for _ in range(len(o["lane_entries"])
+                                     - len(self.lane_entries)))
+            for i, c in enumerate(o["lane_entries"]):
+                self.lane_entries[i] += c
             self.lane_dispatches += o["lane_dispatches"]
             for c, v in o["fallback_causes"].items():
                 self.fallback_causes[c] = (
@@ -235,9 +262,13 @@ class DeviceProfile:
         """JSON-ready doc (sorted, stably rounded). ``pad_waste_pct``
         is the slot share PADDING burned: 1 - live/slots over every
         dispatch; ``lane_skew_pct`` is (max-min)/max over the
-        accumulated per-lane walls — 0 when balanced or single-lane."""
+        accumulated per-lane loads — walls when the wall instrument
+        filled, routed-entry counts otherwise — 0 when balanced or
+        single-lane."""
         with self._lock:
             lanes = list(self.lane_walls_s)
+            entries = list(self.lane_entries)
+            skew_src = [float(x) for x in (lanes or entries)]
             txn_slots = self.txn_slots
             txns_live = self.txns_live
             hits, misses = (self.staging_reuse_hits,
@@ -245,9 +276,9 @@ class DeviceProfile:
             pad_waste = (
                 round((1.0 - txns_live / txn_slots) * 100, 2)
                 if txn_slots else 0.0)
-            lane_max = max(lanes) if lanes else 0.0
+            lane_max = max(skew_src) if skew_src else 0.0
             lane_skew = (
-                round((lane_max - min(lanes)) / lane_max * 100, 2)
+                round((lane_max - min(skew_src)) / lane_max * 100, 2)
                 if lane_max > 0 else 0.0)
             return {
                 "name": self.name,
@@ -273,9 +304,10 @@ class DeviceProfile:
                 "dispatch_wall_ms": round(self.dispatch_wall_s * 1e3, 3),
                 "verdict_reduce_wall_ms": round(
                     self.verdict_reduce_wall_s * 1e3, 3),
-                "lanes": len(lanes),
+                "lanes": max(len(lanes), len(entries)),
                 "lane_dispatches": self.lane_dispatches,
                 "lane_walls_ms": [round(w * 1e3, 3) for w in lanes],
+                "lane_entries": entries,
                 "lane_skew_pct": lane_skew,
                 "fallback_causes": dict(sorted(
                     self.fallback_causes.items())),

@@ -529,6 +529,28 @@ def test_chaos_smoke_contract():
     assert not chaos.armed()
 
 
+def test_shard_smoke_contract():
+    """BENCH_MODE=shard_smoke: the paired local-vs-sharded resolve
+    probe emits the lane-scaling fields the trajectory tracks (the
+    1/3/8-lane throughput map, the headline speedup, the lane-balance
+    instrument, and the two go/no-go booleans the mode gates on). One
+    short round checks the shape; the bench run owns the gate."""
+    out = bench.run_shard_smoke(cpu=True, seconds=0.3)
+    for key in ("value", "vs_baseline", "lanes", "local_txns_per_sec",
+                "sharded_txns_per_sec", "sharded_speedup",
+                "lane_skew_pct", "monotonic_1_3_8", "sharded_ge_local",
+                "platform"):
+        assert key in out, key
+    assert out["metric"] == "resolver_shard_smoke"
+    assert out["value"] > 0
+    assert out["lanes"] == 8
+    assert set(out["sharded_txns_per_sec"]) == {"1", "3", "8"}
+    assert all(v > 0 for v in out["sharded_txns_per_sec"].values())
+    assert 0.0 <= out["lane_skew_pct"] <= 100.0
+    assert isinstance(out["monotonic_1_3_8"], bool)
+    assert isinstance(out["sharded_ge_local"], bool)
+
+
 def test_pack_smoke_contract():
     """BENCH_MODE=pack_smoke emits the pack-path fields the trajectory
     tracks, and the flat path actually beats legacy on this machine."""

@@ -79,6 +79,10 @@ def test_polarity_table():
     assert benchdiff.polarity("profile_overhead_pct") == -1
     assert benchdiff.polarity("staging_reuse_rate") == +1
     assert benchdiff.polarity("hot_range_buckets") == 0  # never flagged
+    # sharded resolve: the headline speedup climbs, the router's lane
+    # imbalance only ever regresses up
+    assert benchdiff.polarity("sharded_speedup") == +1
+    assert benchdiff.polarity("resolver_shard_smoke") == +1
     # multi-region replication: lag and failovers only ever regress up
     assert benchdiff.polarity("replication_lag_ms") == -1
     assert benchdiff.polarity("replication_lag_versions") == -1

@@ -292,13 +292,15 @@ def test_flat_decode_cost_lands_in_dispatch_wall(monkeypatch):
     assert r.dispatch_wall_s - d0 >= 0.05
 
 
-# ─────────────── mesh fleet: per-lane dispatch walls ───────────────
+# ─────────────── mesh fleet: per-lane load instruments ───────────────
 def test_mesh_resolver_exposes_per_lane_walls():
+    # the hash-sharded (replicated-batch) mode keeps the wall-based
+    # instrument: each lane's shard blocked in device order
     cluster = Cluster(n_resolvers=4, resolver_backend="tpu",
-                      **TEST_KNOBS)
+                      resolver_sharding="hash", **TEST_KNOBS)
     try:
         (r,) = cluster.resolvers
-        assert r.n_lanes == 4
+        assert r.n_lanes == 4 and r.sharding == "hash"
         r.resolve_many(_legacy_batches(3))
         snap = r.profile.snapshot()
         assert snap["lanes"] == 4
@@ -307,6 +309,28 @@ def test_mesh_resolver_exposes_per_lane_walls():
         assert all(w >= 0.0 for w in snap["lane_walls_ms"])
         assert 0.0 <= snap["lane_skew_pct"] <= 100.0
         # the cluster doc surfaces the same lanes
+        doc = cluster.device_profile_status()
+        assert doc["aggregate"]["lanes"] == 4
+    finally:
+        cluster.close()
+
+
+def test_mesh_resolver_range_mode_exposes_per_lane_entry_counts():
+    # the range-sharded (default) mode knows lane balance at SPLIT
+    # time: routed-entry counts per lane, same lane_skew_pct rollup
+    cluster = Cluster(n_resolvers=4, resolver_backend="tpu",
+                      **TEST_KNOBS)
+    try:
+        (r,) = cluster.resolvers
+        assert r.n_lanes == 4 and r.sharding == "range"
+        r.resolve_many(_legacy_batches(3))
+        snap = r.profile.snapshot()
+        assert snap["lanes"] == 4
+        assert snap["lane_walls_ms"] == []  # never mixed units
+        assert len(snap["lane_entries"]) == 4
+        assert snap["lane_dispatches"] >= 1
+        assert sum(snap["lane_entries"]) > 0
+        assert 0.0 <= snap["lane_skew_pct"] <= 100.0
         doc = cluster.device_profile_status()
         assert doc["aggregate"]["lanes"] == 4
     finally:

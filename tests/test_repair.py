@@ -179,12 +179,22 @@ def test_unreplayable_op_log_never_replays(cl):
     assert not tr.repair_ready  # unreplayable: fell back to the rerun
 
 
-def test_repair_disabled_by_default():
+def test_repair_default_on_and_knob_opt_out():
+    # default ON since the defaults audit: the same-seed differential
+    # (test_repair_and_scheduling_preserve_final_state) proved repaired
+    # retries reach the restart loop's exact final state
     cl = Cluster(resolver_backend="cpu")
     try:
         tr = cl.database().create_transaction()
+        assert tr._repair is not None
+    finally:
+        cl.close()
+    # knob opt-out restores the restart-only client; the per-txn
+    # option still opts a single transaction back in
+    cl = Cluster(resolver_backend="cpu", txn_repair=False)
+    try:
+        tr = cl.database().create_transaction()
         assert tr._repair is None
-        # per-txn opt-in works without the knob
         tr.options.set_transaction_repair()
         assert tr._repair is not None
     finally:

@@ -138,7 +138,9 @@ def test_proxy_commit_batch_saves_the_reader_and_restores_order():
 
 
 def test_proxy_arrival_order_baseline_aborts_the_reader():
-    cl = Cluster(resolver_backend="cpu")  # knob off: default baseline
+    # knob explicitly off (default flipped ON in the defaults audit):
+    # the arrival-order baseline self-inflicts the in-batch abort
+    cl = Cluster(resolver_backend="cpu", commit_batch_scheduling=False)
     db = cl.database()
     db.set(b"x", b"0")
     w, t = _pair(cl)
