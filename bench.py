@@ -1510,6 +1510,7 @@ def _e2e_line(cpu, metric, vs_of=BASELINE_TXNS_PER_SEC,
         "vs_baseline": round(value / vs_of, 3), **fields,
         "flowlint_by_rule": _flowlint_by_rule(),
         "lockdep_cycles": _lockdep_cycles(),
+        **_faultcov_fields(),
     }
     _emit(line)
     return line
@@ -1694,6 +1695,35 @@ def _lockdep_cycles():
     except Exception as e:
         sys.stderr.write(f"lockdep count failed: {type(e).__name__}: {e}\n")
         return None
+
+
+_FAULTCOV_TABLE = [None]  # static FL011 table: one read per process
+
+
+def _faultcov_fields():
+    """Fault-coverage gauges stamped on every e2e line: the FL011
+    static table size (analysis/faultsites.txt), how many of its
+    entries THIS process's runtime witness (utils/faultcov.py) has
+    seen fire, and the percentage. fired stays 0 when the witness is
+    off — the faultcov_smoke config runs with it ON. Empty dict if
+    the pass fails: coverage accounting must never sink the bench."""
+    try:
+        from foundationdb_tpu.tools import faultcov as faultcov_report
+        from foundationdb_tpu.utils import faultcov
+
+        if _FAULTCOV_TABLE[0] is None:
+            _FAULTCOV_TABLE[0] = faultcov_report.load_table()
+        rep = faultcov_report.coverage_report(
+            faultcov.counts(), _FAULTCOV_TABLE[0])
+        return {
+            "fault_sites_total": rep["sites_total"],
+            "fault_sites_fired": rep["sites_fired"],
+            "fault_coverage_pct": rep["coverage_pct"],
+        }
+    except Exception as e:
+        sys.stderr.write(
+            f"faultcov gauges failed: {type(e).__name__}: {e}\n")
+        return {}
 
 
 def run_pack_smoke(cpu):
@@ -2300,6 +2330,74 @@ def run_lockdep_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_faultcov_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=faultcov_smoke: the runtime fault-coverage witness's
+    overhead budget, measured — the ycsb e2e with the witness ON
+    (every FDBError construction attributes its fabrication site via
+    one frame walk and bumps a per-site counter) vs OFF (one
+    module-global read per construction), interleaved pairs, median
+    throughput each, ≤2% budget (the metrics_smoke protocol). The
+    enabled arms' gauges ride along — the union of fired sites across
+    rounds, diffed against the static FL011 table
+    (analysis/faultsites.txt): coverage is observational, but a fired
+    site ABSENT from the table (``faultcov_violations``) fails the
+    smoke exactly like a lockdep cycle — either the enumeration has a
+    hole or a fabrication site dodged the lint."""
+    from foundationdb_tpu.tools import faultcov as faultcov_report
+    from foundationdb_tpu.utils import faultcov
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    backend = "native"
+    runs = {True: [], False: []}
+    fired = {}
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                faultcov.reset()
+                if on:
+                    faultcov.enable()
+                else:
+                    faultcov.disable()
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    for site, n in faultcov.counts().items():
+                        fired[site] = fired.get(site, 0) + n
+    finally:
+        faultcov.disable()
+        faultcov.reset()
+    rep = faultcov_report.coverage_report(
+        fired, faultcov_report.load_table())
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_faultcov_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "faultcov_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "fault_sites_total": rep["sites_total"],
+        "fault_sites_fired": rep["sites_fired"],
+        "fault_coverage_pct": rep["coverage_pct"],
+        "faultcov_violations": len(rep["violations"]),
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+    }
+
+
 def run_tracing_smoke(cpu, seconds=None, rounds=None, rate=None):
     """BENCH_MODE=tracing_smoke: the distributed-tracing overhead
     budget, measured — the ycsb e2e with tracing at the DEFAULT enabled
@@ -2851,6 +2949,8 @@ def _compact_summary(out, configs):
               "pad_waste_pct", "bucket_histogram", "recompiles",
               "fallback_causes", "lane_skew_pct",
               "flowlint_findings", "flowlint_by_rule", "lockdep_cycles",
+              "fault_sites_total", "fault_sites_fired",
+              "fault_coverage_pct",
               "probe_grv_p99_ms", "probe_commit_p99_ms",
               "recovery_count", "last_recovery_ms", "health_verdict",
               "region_mode", "replication_lag_ms", "region_failovers",
@@ -2901,6 +3001,9 @@ def main():
     # deviceprofile kill switch on vs off, ≤2% budget) |
     # lockdep_smoke (runtime lock-order witness overhead: instrumented
     # vs plain lock factories, ≤2% budget, 0 observed cycles) |
+    # faultcov_smoke (runtime fault-coverage witness overhead: FDBError
+    # site attribution on vs off, ≤2% budget, fired sites must all be
+    # enumerated in analysis/faultsites.txt) |
     # health_smoke (cluster-doctor overhead: latency prober + health
     # rollups on vs the health kill switch off, ≤2% budget) |
     # region_smoke (multi-region replication cost: regions off vs sync
@@ -3040,6 +3143,16 @@ def main():
         # ≤2% budget gate, plus the correctness half: a runtime
         # lock-order cycle under the measured load fails the smoke
         if not out["within_budget"] or out["lockdep_cycles"]:
+            sys.exit(1)
+        return
+
+    if mode == "faultcov_smoke":
+        out = run_faultcov_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # ≤2% budget gate, plus the correctness half: a fired fault
+        # site missing from the static FL011 table fails the smoke
+        if not out["within_budget"] or out["faultcov_violations"]:
             sys.exit(1)
         return
 
@@ -3227,7 +3340,8 @@ def main():
                        "error": f"{type(e).__name__}: {e}"[:300],
                        "flowlint_findings": _flowlint_findings(),
                        "flowlint_by_rule": _flowlint_by_rule(),
-                       "lockdep_cycles": _lockdep_cycles()}
+                       "lockdep_cycles": _lockdep_cycles(),
+                       **_faultcov_fields()}
             _emit(_compact_summary(err_out, configs))
             sys.exit(1)
 
@@ -3313,6 +3427,7 @@ def main():
     out["flowlint_findings"] = _flowlint_findings()
     out["flowlint_by_rule"] = _flowlint_by_rule()
     out["lockdep_cycles"] = _lockdep_cycles()
+    out.update(_faultcov_fields())
     out["configs"] = configs
     watchdog_finish()
     # the rich headline (full detail, for humans reading the log) …

@@ -214,12 +214,13 @@ class FileModel:
     __slots__ = ("relpath", "text", "tree", "syntax_error", "comments",
                  "file_disabled", "line_disabled", "shared_annotations",
                  "classes", "module_funcs", "module_locks",
-                 "imports", "import_files")
+                 "imports", "import_files", "_fabrication_calls")
 
     def __init__(self, relpath, text):
         self.relpath = relpath
         self.text = text
         self.syntax_error = None
+        self._fabrication_calls = None  # FL009/FL011 shared site cache
         try:
             self.tree = ast.parse(text)
         except SyntaxError as e:

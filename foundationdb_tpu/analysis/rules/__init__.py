@@ -1,7 +1,7 @@
 """flowlint rule registry — one module per rule id.
 
 FL001–FL005 are per-file rules (``check(tree, relpath)``);
-FL006–FL008 are program-wide (``PROGRAM = True`` +
+FL006–FL011 are program-wide (``PROGRAM = True`` +
 ``check_model(model)``) and read the shared
 :class:`~foundationdb_tpu.analysis.model.ProgramModel`.
 """
@@ -15,6 +15,9 @@ from foundationdb_tpu.analysis.rules import (
     fl006_lockorder,
     fl007_threadescape,
     fl008_protocol,
+    fl009_errortaxonomy,
+    fl010_retrydiscipline,
+    fl011_faultsites,
 )
 
 ALL_RULES = [
@@ -26,6 +29,9 @@ ALL_RULES = [
     fl006_lockorder,
     fl007_threadescape,
     fl008_protocol,
+    fl009_errortaxonomy,
+    fl010_retrydiscipline,
+    fl011_faultsites,
 ]
 
 BY_ID = {rule.RULE: rule for rule in ALL_RULES}

@@ -3,7 +3,17 @@
 Ref parity: flow/Error.h and the generated error list in
 fdbclient/vexillographer/fdb.options. Codes match the reference so client
 code written against FDB's bindings ports over unchanged.
+
+This registry is also the ground truth for the static error-taxonomy
+pass (flowlint FL009): every fabrication site in the tree must use a
+code registered here, by symbolic name — raw numeric literals outside
+this file fail the lint. The runtime fault-coverage witness
+(utils/faultcov.py, the dynamic twin of flowlint FL011) hooks
+``FDBError.__init__``: one module-global read when off, a per-site
+counter bump when on.
 """
+
+from foundationdb_tpu.utils import faultcov as _faultcov
 
 _ERRORS = {
     0: "success",
@@ -54,6 +64,34 @@ RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1213, 2144})
 MAYBE_COMMITTED = frozenset({1021})
 
 
+def registered_codes():
+    """Frozen set of every registered error code (FL009's ground truth
+    for numeric codes crossing the wire)."""
+    return frozenset(_ERRORS)
+
+
+def registered_names():
+    """Frozen set of every registered symbolic error name."""
+    return frozenset(_BY_NAME)
+
+
+def code_for(name):
+    """The registered code for a symbolic name, or a clear ValueError
+    naming the bad symbol (a bare KeyError names nothing)."""
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown FDB error name {name!r} — register it in "
+            f"core/errors.py"
+        ) from None
+
+
+def error_name(code):
+    """The symbolic name for a code, or 'unknown_error'."""
+    return _ERRORS.get(code, "unknown_error")
+
+
 class FDBError(Exception):
     """An error with an FDB error code. Ref: class Error in flow/Error.h."""
 
@@ -61,10 +99,12 @@ class FDBError(Exception):
         self.code = int(code)
         self.description = _ERRORS.get(self.code, "unknown_error")
         super().__init__(message or f"{self.description} ({self.code})")
+        if _faultcov._enabled:
+            _faultcov.note(self.code)
 
     @classmethod
-    def from_name(cls, name):
-        return cls(_BY_NAME[name])
+    def from_name(cls, name, message=None):
+        return cls(code_for(name), message)
 
     @property
     def is_retryable(self):
@@ -75,6 +115,7 @@ class FDBError(Exception):
         return self.code in MAYBE_COMMITTED
 
 
-def err(name):
-    """Raise-ready FDBError by symbolic name, e.g. err('not_committed')."""
-    return FDBError.from_name(name)
+def err(name, message=None):
+    """Raise-ready FDBError by symbolic name, e.g. err('not_committed').
+    Unknown names raise ValueError naming the symbol, not KeyError."""
+    return FDBError.from_name(name, message)

@@ -353,11 +353,11 @@ class StorageWorker:
         caller's on_error loop treats a slow or detached worker like
         any lagging storage (1037: behind, catch up and retry)."""
         if not self._caught_up.wait(timeout):
-            raise FDBError(1037, f"{self.name} still bootstrapping "
-                                 f"(process_behind)")
+            raise err("process_behind",
+                      f"{self.name} still bootstrapping (process_behind)")
         if self._detach_error is not None:
-            raise FDBError(
-                1037,
+            raise err(
+                "process_behind",
                 f"{self.name} detached during bootstrap: "
                 f"{str(self._detach_error)[:120]}",
             )

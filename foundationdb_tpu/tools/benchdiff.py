@@ -65,6 +65,10 @@ HIGHER_BETTER = (
     # resolved txns/sec — more is better ("sharded_speedup" and
     # "lane_skew_pct" already resolve via "speedup" / "lane_skew")
     "shard_smoke",
+    # fault coverage (ISSUE 17): firing MORE of the enumerated fault
+    # sites under chaos is better exploration; fault_sites_total stays
+    # neutral (the table growing is neither good nor bad per se)
+    "fault_sites_fired", "fault_coverage",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

@@ -55,6 +55,10 @@ def test_compact_summary_is_small_and_headline_last():
         # robustness stack (ISSUE 15): RPC deadline expiries, failed
         # endpoints, and backoff sleeps taken — zeros must still ride
         "rpc_timeouts": 0, "endpoints_failed": 0, "backoff_retries": 3,
+        # fault coverage (ISSUE 17): static FL011 table size, fired
+        # subset, and pct — a fired count of 0 must still ride
+        "fault_sites_total": 118, "fault_sites_fired": 0,
+        "fault_coverage_pct": 0.0,
     }
     configs = {
         "range": {"value": 390000.0, "vs_baseline": 0.39},
@@ -88,6 +92,10 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["flowlint_findings"] == 0
     assert line["flowlint_by_rule"] == {}
     assert line["lockdep_cycles"] == 0
+    # fault-coverage gauges ride the summary; fired=0 still present
+    assert line["fault_sites_total"] == 118
+    assert line["fault_sites_fired"] == 0
+    assert line["fault_coverage_pct"] == 0.0
     # workload attribution rides the summary: bucket bound + hottest
     # conflict range + tag count are tracked numbers per run
     assert line["hot_range_buckets"] == 192
@@ -153,7 +161,7 @@ def test_flowlint_findings_gauge_matches_the_tree():
 
 def test_flowlint_by_rule_and_lockdep_gauges_are_clean():
     """The per-rule split is empty on a clean tree (the program rules
-    FL006–FL008 included), and the runtime lockdep witness has observed
+    FL006–FL011 included), and the runtime lockdep witness has observed
     no lock-order cycle in this process."""
     by_rule = bench._flowlint_by_rule()
     assert by_rule == {}, f"per-rule lint debt: {by_rule}"
@@ -412,6 +420,33 @@ def test_lockdep_smoke_contract():
 
     assert not lockdep.enabled()
     assert lockdep.edge_set() == frozenset()
+
+
+def test_faultcov_smoke_contract():
+    """BENCH_MODE=faultcov_smoke: the runtime fault-coverage witness
+    overhead probe emits the budget fields plus the coverage gauges
+    from the enabled arms, fires no unenumerated site, and restores
+    the disabled default. One short round checks the contract; the
+    bench run owns the statistically serious comparison."""
+    out = bench.run_faultcov_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "faultcov_overhead_pct", "overhead_budget_pct",
+                "within_budget", "fault_sites_total",
+                "fault_sites_fired", "fault_coverage_pct",
+                "faultcov_violations"):
+        assert key in out, key
+    assert out["metric"] == "e2e_faultcov_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the static table was read (FL011 enumerates a non-trivial tree)
+    assert out["fault_sites_total"] > 50
+    # every fired site was statically enumerated — the FL011 contract
+    assert out["faultcov_violations"] == 0
+    assert 0 <= out["fault_sites_fired"] <= out["fault_sites_total"]
+    # the probe restored the default (witness off, counters clear)
+    from foundationdb_tpu.utils import faultcov
+
+    assert not faultcov.enabled()
+    assert faultcov.fired() == frozenset()
 
 
 def test_tracing_smoke_contract():

@@ -40,11 +40,65 @@ def test_package_tree_has_no_new_findings():
 
 
 def test_baseline_is_empty_for_hard_rules():
-    """The shipped contract: FL001/FL002/FL003/FL005 carry NO
-    grandfathered findings — only FL004 (jit purity) may hold debt."""
+    """The shipped contract: every rule except FL004 (jit purity, the
+    only sanctioned debt ledger) carries NO grandfathered findings —
+    including the v3 error-propagation rules FL009–FL011, whose
+    sanction channels are errortable.txt / faultsites.txt, never the
+    baseline."""
     baseline = flowlint.load_baseline(flowlint.default_baseline_path())
     hard = [k for k in baseline if not k.startswith("FL004\t")]
     assert hard == [], f"hard-rule findings grandfathered: {hard}"
+
+
+def test_v3_rules_are_registered_program_rules():
+    """FL009–FL011 ride the shared ProgramModel pass of the tier-1
+    tree lint above — a rule silently dropped from the registry would
+    make that gate vacuous for it."""
+    from foundationdb_tpu.analysis.rules import ALL_RULES, BY_ID
+
+    for rid in ("FL009", "FL010", "FL011"):
+        assert rid in BY_ID, f"{rid} missing from the rule registry"
+        assert getattr(BY_ID[rid], "PROGRAM", False)
+        assert BY_ID[rid] in ALL_RULES
+
+
+def test_desynced_faultsites_table_is_caught():
+    """The acceptance probe for the FL011 ledger, without mutating the
+    tree: dropping a real entry from faultsites.txt must surface as an
+    unenumerated-site finding, and a fabricated entry as stale."""
+    from foundationdb_tpu.analysis.model import build_model
+    from foundationdb_tpu.analysis.rules import fl011_faultsites
+
+    pkg = flowlint.package_dir()
+    root = os.path.dirname(pkg)
+    items = []
+    for p in flowlint.iter_py_files([pkg]):
+        with open(p, encoding="utf-8") as f:
+            items.append((flowlint.module_relpath(p, root), f.read()))
+    table_path = os.path.join(pkg, "analysis", "faultsites.txt")
+    with open(table_path, encoding="utf-8") as f:
+        lines = f.read().splitlines(keepends=True)
+    sites = [ln for ln in lines if ln.strip()
+             and not ln.lstrip().startswith("#")]
+    assert sites, "checked-in faultsites.txt must enumerate sites"
+    dropped = lines.copy()
+    dropped.remove(sites[0])
+    dropped_site = sites[0].split()[0]
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        os.mkdir(os.path.join(td, "analysis"))
+        tbl = os.path.join(td, "analysis", "faultsites.txt")
+        with open(tbl, "w", encoding="utf-8") as f:
+            f.writelines(dropped + ["server.nowhere:ghost:9999\n"])
+        model = build_model(items, full_tree=True, package_root=td)
+        msgs = [f.message
+                for f in fl011_faultsites.check_model(model)]
+    assert any(f"unenumerated fault site: {dropped_site}" in m
+               for m in msgs), msgs
+    assert any("stale fault site: server.nowhere:ghost:9999" in m
+               for m in msgs), msgs
 
 
 def test_reintroducing_ambient_entropy_is_caught():
