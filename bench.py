@@ -1194,6 +1194,19 @@ def run_e2e_multiproc(seconds=None, n_clients=None):
                 p.kill()
 
 
+def _pallas_step_executed(params, prof):
+    """``pallas_kernel_step`` stamped from the route actually EXECUTED.
+    The params flag alone is the *request*: a run that silently fell
+    back via the pallas_to_jit taxonomy used to stamp ``true`` anyway
+    (the ISSUE 18 satellite bug at the two emit sites). Folding in the
+    device profiler's fallback-cause counters makes the stamp honest —
+    true only when a Pallas route was requested AND no pallas→jnp
+    retry was recorded anywhere in the run."""
+    requested = bool(params.use_pallas or params.use_pallas_scan)
+    causes = prof.snapshot()["fallback_causes"]
+    return requested and not causes.get("pallas_to_jit", 0)
+
+
 def run_kernel_bench(point, cpu, fallback_note):
     """One kernel-throughput config (point YCSB-A or range-heavy):
     scanned multi-batch dispatches under a bounded pipeline. Returns the
@@ -1239,6 +1252,25 @@ def run_kernel_bench(point, cpu, fallback_note):
     pallas_note = None
     if not cpu and not point and env("BENCH_PALLAS", "1") != "0":
         params = params._replace(use_pallas=True)
+    # The fused accept kernel (ops/pallas_scan.py): the WHOLE per-batch
+    # step — ring check, intra-batch segment intersection, greedy
+    # acceptance — as one pallas_call, riding INSIDE the throughput
+    # scan (make_resolve_scan_fn keeps use_pallas_scan; there is no
+    # jnp/pallas split for XLA to schedule around). Auto = TPU and the
+    # batch within the kernel's txn-tile budget; BENCH_PALLAS_SCAN=1
+    # forces, =0 disables.
+    from foundationdb_tpu.ops.pallas_scan import MAX_TXNS as _SCAN_MAX
+    from foundationdb_tpu.utils import deviceprofile
+
+    scan_knob = env("BENCH_PALLAS_SCAN", "auto")
+    if scan_knob == "1" or (scan_knob == "auto" and not cpu
+                            and params.txns <= _SCAN_MAX):
+        params = params._replace(use_pallas_scan=True, use_pallas=False)
+    # fallback-cause ledger for THIS bench run: every pallas→jnp retry
+    # below records pallas_to_jit into it, and the pallas_kernel_step
+    # stamp is computed from it — the route EXECUTED, not the route
+    # requested (the satellite fix: the old stamp echoed params.use_pallas)
+    prof = deviceprofile.DeviceProfile("bench-kernel")
 
     build = build_batches if point else build_range_batches
     batches = build(params, nbatches, nkeys, theta=0.99)
@@ -1260,10 +1292,13 @@ def run_kernel_bench(point, cpu, fallback_note):
         state, st = step(state, megas[0])
         np.asarray(st)
     except Exception as e:
-        if not scan_pallas:
+        if not (scan_pallas or params.use_pallas_scan):
             raise
         sys.stderr.write(f"pallas scan failed, jnp lanes: {e}\n")
+        pallas_note = f"{type(e).__name__}: {e}"[:200]
+        prof.record_fallback("pallas_to_jit")
         scan_pallas = False
+        params = params._replace(use_pallas_scan=False)
         step = ck.make_resolve_scan_fn(params, donate=True)
         state = ck.init_state(params)
         state, st = step(state, megas[0])
@@ -1276,11 +1311,12 @@ def run_kernel_bench(point, cpu, fallback_note):
     try:
         kernel_ms = measure_kernel_step_ms(ck, params, batches[0])
     except Exception as e:
-        if not params.use_pallas:
+        if not (params.use_pallas or params.use_pallas_scan):
             raise
         pallas_note = f"{type(e).__name__}: {e}"[:200]
         sys.stderr.write(f"pallas ring kernel failed, jnp lanes: {e}\n")
-        params = params._replace(use_pallas=False)
+        prof.record_fallback("pallas_to_jit")
+        params = params._replace(use_pallas=False, use_pallas_scan=False)
         kernel_ms = measure_kernel_step_ms(ck, params, batches[0])
 
     # conflict_check_p99_ms — the <2ms half of the north star, measured
@@ -1298,6 +1334,12 @@ def run_kernel_bench(point, cpu, fallback_note):
                                   4096 if not cpu else 256)),
             use_pallas=not cpu and env("BENCH_PALLAS", "1") != "0",
         )
+        # the latency batch (1024 txns) fits the fused kernel's tile
+        # budget even when the throughput shape above did not
+        if scan_knob == "1" or (scan_knob == "auto" and not cpu
+                                and lat_params.txns <= _SCAN_MAX):
+            lat_params = lat_params._replace(use_pallas_scan=True,
+                                             use_pallas=False)
         lat_batches = build_batches(lat_params, 8, nkeys, theta=0.99,
                                     seed=7)
         lat_trials = int(env("BENCH_LAT_TRIALS", 24 if not cpu else 4))
@@ -1306,11 +1348,13 @@ def run_kernel_bench(point, cpu, fallback_note):
                 ck, lat_params, lat_batches, trials=lat_trials
             )
         except Exception as e:
-            if not lat_params.use_pallas:
+            if not (lat_params.use_pallas or lat_params.use_pallas_scan):
                 raise
             pallas_note = f"{type(e).__name__}: {e}"[:200]
             sys.stderr.write(f"pallas latency path failed, jnp: {e}\n")
-            lat_params = lat_params._replace(use_pallas=False)
+            prof.record_fallback("pallas_to_jit")
+            lat_params = lat_params._replace(use_pallas=False,
+                                             use_pallas_scan=False)
             p99, mean = measure_conflict_check_latency(
                 ck, lat_params, lat_batches, trials=lat_trials
             )
@@ -1330,12 +1374,14 @@ def run_kernel_bench(point, cpu, fallback_note):
             sys.stderr.write(f"device latency path failed: {e}\n")
             dev_p99, dev_mean = p99, mean
             estimator = "dispatch-fallback"
-            if lat_params.use_pallas:
+            if lat_params.use_pallas or lat_params.use_pallas_scan:
                 # only a Pallas config gets (and labels) a jnp retry
                 pallas_note = f"{type(e).__name__}: {e}"[:200]
+                prof.record_fallback("pallas_to_jit")
                 try:
                     dev_p99, dev_mean = measure_conflict_check_device(
-                        ck, lat_params._replace(use_pallas=False),
+                        ck, lat_params._replace(use_pallas=False,
+                                                use_pallas_scan=False),
                         lat_batches, trials=dev_trials,
                     )
                     estimator = "device-jnp"
@@ -1350,10 +1396,9 @@ def run_kernel_bench(point, cpu, fallback_note):
             "conflict_check_dispatch_mean_ms": round(mean, 3),
             "conflict_check_estimator": estimator,
             "conflict_check_batch": lat_params.txns,
-            # False when the published latency came from the jnp retry
-            "pallas_kernel_step": bool(
-                lat_params.use_pallas and estimator != "device-jnp"
-            ),
+            # the route actually EXECUTED (request flag folded with the
+            # run's pallas_to_jit fallback ledger), not the request
+            "pallas_kernel_step": _pallas_step_executed(lat_params, prof),
         }
 
     committed = 0
@@ -1434,9 +1479,13 @@ def run_kernel_bench(point, cpu, fallback_note):
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         # pallas drives kernel_step_ms (the latency path); range mode
-        # also keeps it inside the throughput scan (pallas_scan)
-        "pallas_kernel_step": bool(params.use_pallas),
+        # can also keep the ring inside the throughput scan
+        # (pallas_scan), and the fused accept kernel always rides the
+        # scan when engaged (fused_scan_kernel). The stamp reflects the
+        # route EXECUTED: any pallas_to_jit fallback this run flips it.
+        "pallas_kernel_step": _pallas_step_executed(params, prof),
         "pallas_scan": scan_pallas,
+        "fused_scan_kernel": bool(params.use_pallas_scan),
         # workload scale, so CPU-scaled fallback runs are self-describing
         "nkeys": nkeys,
         "nbatches": nbatches,
@@ -1822,6 +1871,133 @@ def run_pack_smoke(cpu):
         "pack_batches_per_group": NB,
         "pack_bytes": flat.pack_bytes * NB,
         "pack_reuse_rate": round(hits / max(hits + misses, 1), 3),
+    }
+
+
+# kernel_smoke pad-waste gate: the slot share padding may burn on the
+# ycsb-shaped backlog ladder (the extended 2/4/8/16/32 buckets). The
+# worst ladder points (3→4, 5→8, 12→16, 20→32 batches) bound the
+# blended waste near 40% on the smoke's fixed workload; 45 is the
+# checked-in regression tripwire, not an optimum.
+KERNEL_SMOKE_PAD_WASTE_MAX = 45.0
+
+
+def run_kernel_smoke(cpu):
+    """BENCH_MODE=kernel_smoke: the fused Pallas accept kernel
+    (ops/pallas_scan.py) driven through the REAL resolver paths on the
+    cpu interpreter, against the jit/jnp scan as the parity oracle.
+    Three gates ride the exit code: (1) verdict parity — point / range
+    / mixed / empty / backlog-pad fixtures must be bit-identical
+    between pallas_scan="on" (interpreter off-TPU) and "off"; (2) the
+    pallas_kernel_step stamp is computed from the route actually
+    executed (the profiler's kernel_routes + zero pallas_to_jit
+    fallbacks), never from the request flag; (3) pad_waste_pct on the
+    ycsb-shaped backlog ladder stays under KERNEL_SMOKE_PAD_WASTE_MAX.
+    The kernel-vs-jit step walls ride along (on cpu the interpreter is
+    expected to LOSE — the number exists for trajectory, the gates are
+    correctness)."""
+    import random as _random
+
+    import jax
+
+    from foundationdb_tpu.core.options import Knobs
+    from foundationdb_tpu.resolver.resolver import Resolver
+    from foundationdb_tpu.resolver.skiplist import TxnRequest
+
+    env = os.environ.get
+    T = int(env("BENCH_KERNEL_TXNS", 64))
+    knobs_kw = dict(
+        resolver_backend="tpu", batch_txn_capacity=T,
+        point_reads_per_txn=2, point_writes_per_txn=2,
+        range_reads_per_txn=1, range_writes_per_txn=1,
+        key_limbs=2, hash_table_bits=14, range_ring_capacity=128,
+        coarse_buckets_bits=8,
+    )
+
+    def drive(mode):
+        rng = _random.Random(1234)
+        r = Resolver(Knobs(**knobs_kw, pallas_scan=mode))
+        out = []
+        v = 100
+        nk = 300  # zipf-less stand-in: small keyspace => real conflicts
+
+        def key():
+            return b"user%06d" % rng.randrange(nk)
+
+        def span():
+            a, b = sorted((key(), key()))
+            return (a, b + b"\xff")
+
+        def txn(kind):
+            pt = kind in ("point", "mixed")
+            rg = kind in ("range", "mixed")
+            return TxnRequest(
+                read_version=v - rng.randrange(0, 12),
+                point_reads=[key() for _ in range(rng.randrange(3))] if pt else [],
+                point_writes=[key() for _ in range(rng.randrange(3))] if pt else [],
+                range_reads=[span() for _ in range(rng.randrange(2))] if rg else [],
+                range_writes=[span() for _ in range(rng.randrange(2))] if rg else [],
+            )
+
+        def batch(kind, n):
+            nonlocal v
+            txns = [txn(kind) for _ in range(n)]
+            v += rng.randrange(1, 5)
+            return (txns, v, max(0, v - 60))
+
+        t0 = time.perf_counter()
+        # sequential fixtures: point-only first (exercises the fast
+        # variant handoff), then range/mixed/empty through the kernel
+        for kind in ("point", "range", "mixed", "empty"):
+            for _ in range(3):
+                out.append(r.resolve(*batch(kind, rng.randrange(1, T + 1))))
+        out.append(r.resolve(*batch("mixed", 0)))  # zero-txn batch
+        # the ycsb-shaped backlog ladder: FULL batches (a loaded ycsb
+        # stream fills the capacity) at depths landing on and between
+        # the extended buckets (2/4/8/16/32) — the pad_waste_pct source
+        for depth in (2, 3, 5, 12, 20):
+            bs = [batch("mixed", T) for _ in range(depth)]
+            out.extend(r.resolve_many(bs))
+        wall = time.perf_counter() - t0
+        return r, out, wall
+
+    r_off, out_off, wall_off = drive("off")
+    r_on, out_on, wall_on = drive("on")
+    parity = out_on == out_off
+    snap_on = r_on.profile.snapshot()
+    snap_off = r_off.profile.snapshot()
+    routes = snap_on["kernel_routes"]
+    fallbacks = snap_on["fallback_causes"].get("pallas_to_jit", 0)
+    # the executed-route stamp (satellite fix): the kernel must have
+    # actually served dispatches AND never fallen back
+    kernel_executed = bool(routes.get("pallas_scan", 0)) and not fallbacks
+    pad_waste = snap_on["pad_waste_pct"]
+    n_txns = sum(len(s) for s in out_on)
+    ok = (parity and kernel_executed
+          and pad_waste <= KERNEL_SMOKE_PAD_WASTE_MAX)
+    return {
+        "metric": "kernel_smoke_parity",
+        "value": 1.0 if parity else 0.0,
+        "unit": "bool",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "within_budget": ok,
+        "parity": parity,
+        "pallas_kernel_step": kernel_executed,
+        "kernel_routes": dict(routes),
+        "pallas_to_jit_fallbacks": int(fallbacks),
+        "pad_waste_pct": pad_waste,
+        "pad_waste_max_pct": KERNEL_SMOKE_PAD_WASTE_MAX,
+        "bucket_histogram": snap_on["bucket_histogram"],
+        "kernel_step_ms": round(
+            wall_on / max(snap_on["dispatches"], 1) * 1e3, 3),
+        "jit_step_ms": round(
+            wall_off / max(snap_off["dispatches"], 1) * 1e3, 3),
+        "device_kernel_txns_per_sec": round(n_txns / max(wall_on, 1e-9), 1),
+        "jit_txns_per_sec": round(n_txns / max(wall_off, 1e-9), 1),
+        "txns": n_txns,
+        "batch_capacity": T,
+        "interpreter": jax.default_backend() != "tpu",
+        "platform": jax.devices()[0].platform,
     }
 
 
@@ -2989,7 +3165,11 @@ def main():
     mode = env("BENCH_MODE", "all")  # all | point | range |
     # ring_capacity | pipeline_smoke (quick commit-pipeline regression
     # probe) | pack_smoke (packing-only: flat vs legacy host pack
-    # stage) | metrics_smoke (metrics-registry overhead: enabled vs
+    # stage) | kernel_smoke (fused Pallas accept kernel on the cpu
+    # interpreter vs the jit scan through the real resolver paths:
+    # bit-identical verdict parity, executed-route pallas_kernel_step
+    # stamp, pad_waste_pct under the checked-in threshold — all three
+    # gate exit) | metrics_smoke (metrics-registry overhead: enabled vs
     # disabled ycsb e2e, ≤2% budget) | tracing_smoke (distributed-
     # tracing overhead at the default 1% sample rate, ≤2% budget, plus
     # span-tree vs stage-timer critical-path cross-check) |
@@ -3224,6 +3404,17 @@ def main():
         out = run_pack_smoke(cpu)
         watchdog_finish()
         _emit(out)
+        return
+
+    if mode == "kernel_smoke":
+        out = run_kernel_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # three gates: interpreter parity with the jnp path, an honest
+        # executed-route pallas_kernel_step stamp, pad waste under the
+        # checked-in threshold on the extended bucket ladder
+        if not out["within_budget"]:
+            sys.exit(1)
         return
 
     if mode == "ring_capacity":

@@ -28,6 +28,14 @@ class Knobs:
     # "auto" = on TPU backends, "on" = everywhere (interpreter off-TPU,
     # for differential tests), "off" = always the jnp lanes
     pallas_ring: str = "auto"
+    # the FULL per-batch accept step as one fused Pallas kernel
+    # (ops/pallas_scan.py): exact ring check + intra-batch segment
+    # intersection + greedy acceptance in VMEM, subsuming pallas_ring's
+    # lane when engaged. Same tri-state as pallas_ring; auto-gates off
+    # when the static shape is ineligible (txns > 1024, partitioned
+    # ring) and falls back to the jit path under the pallas_to_jit
+    # taxonomy on lowering errors.
+    pallas_scan: str = "auto"
     # mesh lane ownership (resolver/meshresolver.py, multi-lane tpu
     # fleets only): "range" routes each packed entry host-side to the
     # lane(s) owning its key range (resolver/packing.ShardRouter) and

@@ -94,6 +94,15 @@ class ResolverParams(NamedTuple):
     # which is what bounds range-heavy throughput on-device. 0 = flat
     # ring (the mesh-sharded path always uses the flat ring).
     ring_partition_bits: int = 0
+    # the FULL accept step as one fused Pallas kernel
+    # (ops/pallas_scan.py): exact ring check + all four intra-batch
+    # segment-intersection lanes + greedy acceptance in VMEM, with only
+    # the verdict bits leaving the kernel. Subsumes use_pallas's ring
+    # lane when set (the ring check moves inside the fused kernel); the
+    # jnp history epilogue is shared, so both routes update state
+    # identically. Single-device flat-ring layout only, T <= 1024
+    # (validate_params enforces both).
+    use_pallas_scan: bool = False
 
 
 class ResolverState(NamedTuple):
@@ -345,16 +354,24 @@ def resolve_batch(
         # query's MIDDLE partitions (its end partitions get exact checks)
         part_max = jnp.max(jnp.where(rm_p, rv_p, u32(0)), axis=1)
 
-    # the Pallas ring kernel runs the single-shard path only (each
+    # the Pallas kernels run the single-shard flat-ring path only (each
     # shard_map lane is its own program; the jnp lanes stay canonical
     # there; the partitioned ring has its own gather-based layout)
-    # — interpret mode keeps it runnable (and differential-testable)
-    # on CPU
-    pallas_ring_on = params.use_pallas and axis_name is None and not PB
+    # — interpret mode keeps them runnable (and differential-testable)
+    # on CPU. The fused scan kernel subsumes the ring kernel: when it is
+    # on, the exact ring check happens INSIDE the fused accept step and
+    # the standalone ring lanes here are skipped entirely.
+    pallas_scan_on = (
+        params.use_pallas_scan and axis_name is None and not PB
+    )
+    pallas_ring_on = (
+        params.use_pallas and axis_name is None and not PB
+        and not pallas_scan_on
+    )
+    if pallas_ring_on or pallas_scan_on:
+        interp = jax.default_backend() != "tpu"
     if pallas_ring_on:
         from foundationdb_tpu.ops.pallas_ring import ring_hits
-
-        interp = jax.default_backend() != "tpu"
 
     # point reads vs point-write hash table (exact lane)
     if params.point_reads:
@@ -366,7 +383,10 @@ def resolve_batch(
             # lane counts come from the arrays: packers may statically
             # zero-width lanes a workload never uses
             PR = batch.pr_key.shape[1]
-            if pallas_ring_on and PR:
+            if pallas_scan_on:
+                # exact ring lane fused into the accept kernel below
+                ring_hit = None
+            elif pallas_ring_on and PR:
                 flat_k = batch.pr_key.reshape(T * PR, params.key_width)
                 rv_q = jnp.broadcast_to(rv[:, None], (T, PR)).reshape(-1)
                 ring_hit = ring_hits(
@@ -390,7 +410,8 @@ def resolve_batch(
                 )  # [T, PR, KR]
                 newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
                 ring_hit = jnp.any(in_rng & newer, axis=2)
-            hit |= ring_hit & batch.pr_mask
+            if ring_hit is not None:
+                hit |= ring_hit & batch.pr_mask
             # point reads vs evicted range-writes (coarse interval summary)
             coarse = jnp.minimum(pref_L[batch.pr_bucket], suf_R[batch.pr_bucket])
             hit |= (coarse > rv[:, None]) & batch.pr_mask
@@ -401,7 +422,10 @@ def resolve_batch(
         hit = jnp.zeros((T, params.range_reads), bool)
         if params.range_writes:
             RR = batch.rr_b.shape[1]
-            if pallas_ring_on and RR:
+            if pallas_scan_on:
+                # exact ring lane fused into the accept kernel below
+                ring_hit = None
+            elif pallas_ring_on and RR:
                 rv_q = jnp.broadcast_to(rv[:, None], (T, RR)).reshape(-1)
                 ring_hit = ring_hits(
                     batch.rr_b.reshape(T * RR, params.key_width),
@@ -444,7 +468,8 @@ def resolve_batch(
                 )  # [T, RR, KR]
                 newer = (state.ring_v[None, None] > rv[:, None, None]) & state.ring_mask[None, None]
                 ring_hit = jnp.any(ov & newer, axis=2)
-            hit |= ring_hit & batch.rr_mask
+            if ring_hit is not None:
+                hit |= ring_hit & batch.rr_mask
             coarse_rng = jnp.minimum(pref_L[batch.rr_hi], suf_R[batch.rr_lo])
             hit |= (coarse_rng > rv[:, None]) & batch.rr_mask
         if params.point_writes:
@@ -455,71 +480,84 @@ def resolve_batch(
 
     hist = por(hist)
 
-    # ─────────────────────── intra-batch conflict matrix ───────────────────
-    # O[t1, t2]: an accepted t1 < t2 would abort t2 (t1's writes hit t2's
-    # reads). Each shard builds rows only from writes it owns; the Jacobi
-    # loop OR-reduces the kill vectors.
-    O = jnp.zeros((T, T), bool)
-    if params.point_writes and params.point_reads:
-        w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
-        wh = jnp.where(w_ok, batch.pw_hash, u32(0xFFFFFFFF))  # [T, PW]
-        rh = jnp.where(batch.pr_mask, batch.pr_hash, u32(0xFFFFFFFE))  # [T, PR]
-        eq = wh[:, :, None, None] == rh[None, None, :, :]  # [T1, PW, T2, PR]
-        O |= jnp.any(eq, axis=(1, 3))
-    if params.point_writes and params.range_reads:
-        inr = _point_in(
-            batch.pw_key[:, :, None, None, :], batch.rr_b[None, None], batch.rr_e[None, None]
-        )  # [T1, PW, T2, RR]
-        w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
-        m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
-        O |= jnp.any(inr & m, axis=(1, 3))
-    if params.range_writes and params.point_reads:
-        inr = _point_in(
-            batch.pr_key[None, None],  # [1, 1, T2, PR, W]
-            batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
-            batch.rw_e[:, :, None, None, :],
-        )  # [T1, RW, T2, PR]
-        w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
-        m = w_ok[:, :, None, None] & batch.pr_mask[None, None]
-        O |= jnp.any(inr & m, axis=(1, 3))
-    if params.range_writes and params.range_reads:
-        ov = ranges_overlap(
-            batch.rr_b[None, None],  # [1, 1, T2, RR, W]
-            batch.rr_e[None, None],
-            batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
-            batch.rw_e[:, :, None, None, :],
-        )
-        w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
-        m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
-        O |= jnp.any(ov & m, axis=(1, 3))
-
-    strict_lower = jnp.tril(jnp.ones((T, T), bool), k=-1).T  # [t1 < t2]
-    O &= strict_lower & batch.txn_mask[:, None] & batch.txn_mask[None, :]
-
-    # ───────────────── Jacobi fixpoint for sequential acceptance ───────────
-    # The kill vector is psum-reduced per iteration rather than OR-folding
-    # the whole [T,T] matrix up front: d small [T] reductions measure
-    # cheaper than one [T,T] all-reduce for the shallow conflict chains
-    # real batches carry (d is the chain depth, typically 1-3).
+    # a0: admissible before intra-batch ordering (history + window + mask)
     a0 = (~too_old) & (~hist) & batch.txn_mask
-    Of = O.astype(jnp.bfloat16)
 
-    def cond(carry):
-        _, changed = carry
-        return changed
+    if pallas_scan_on:
+        # ── fused accept kernel: exact ring check + intra-batch
+        # segment intersection + greedy acceptance in one pallas_call.
+        # Greedy sequential acceptance is the unique fixpoint of the
+        # Jacobi map below (induction on txn index), so this route is
+        # bit-identical to the jnp one.
+        from foundationdb_tpu.ops.pallas_scan import fused_accept
 
-    def body(carry):
-        a, _ = carry
-        killed_local = jnp.dot(
-            a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32
-        )
-        if axis_name is not None:
-            killed_local = jax.lax.psum(killed_local, axis_name)
-        killed = killed_local > 0.5
-        a_new = a0 & ~killed
-        return a_new, jnp.any(a_new != a)
+        accepted = fused_accept(state, batch, params, a0, interpret=interp)
+    else:
+        # ───────────────── intra-batch conflict matrix ─────────────────
+        # O[t1, t2]: an accepted t1 < t2 would abort t2 (t1's writes hit
+        # t2's reads). Each shard builds rows only from writes it owns;
+        # the Jacobi loop OR-reduces the kill vectors.
+        O = jnp.zeros((T, T), bool)
+        if params.point_writes and params.point_reads:
+            w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
+            wh = jnp.where(w_ok, batch.pw_hash, u32(0xFFFFFFFF))  # [T, PW]
+            rh = jnp.where(batch.pr_mask, batch.pr_hash, u32(0xFFFFFFFE))  # [T, PR]
+            eq = wh[:, :, None, None] == rh[None, None, :, :]  # [T1, PW, T2, PR]
+            O |= jnp.any(eq, axis=(1, 3))
+        if params.point_writes and params.range_reads:
+            inr = _point_in(
+                batch.pw_key[:, :, None, None, :], batch.rr_b[None, None], batch.rr_e[None, None]
+            )  # [T1, PW, T2, RR]
+            w_ok = batch.pw_mask & hash_owned(batch.pw_hash)
+            m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
+            O |= jnp.any(inr & m, axis=(1, 3))
+        if params.range_writes and params.point_reads:
+            inr = _point_in(
+                batch.pr_key[None, None],  # [1, 1, T2, PR, W]
+                batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
+                batch.rw_e[:, :, None, None, :],
+            )  # [T1, RW, T2, PR]
+            w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
+            m = w_ok[:, :, None, None] & batch.pr_mask[None, None]
+            O |= jnp.any(inr & m, axis=(1, 3))
+        if params.range_writes and params.range_reads:
+            ov = ranges_overlap(
+                batch.rr_b[None, None],  # [1, 1, T2, RR, W]
+                batch.rr_e[None, None],
+                batch.rw_b[:, :, None, None, :],  # [T1, RW, 1, 1, W]
+                batch.rw_e[:, :, None, None, :],
+            )
+            w_ok = batch.rw_mask & bucket_owned(batch.rw_lo)
+            m = w_ok[:, :, None, None] & batch.rr_mask[None, None]
+            O |= jnp.any(ov & m, axis=(1, 3))
 
-    accepted, _ = jax.lax.while_loop(cond, body, (a0, jnp.array(True)))
+        strict_lower = jnp.tril(jnp.ones((T, T), bool), k=-1).T  # [t1 < t2]
+        O &= strict_lower & batch.txn_mask[:, None] & batch.txn_mask[None, :]
+
+        # ───────── Jacobi fixpoint for sequential acceptance ─────────
+        # The kill vector is psum-reduced per iteration rather than
+        # OR-folding the whole [T,T] matrix up front: d small [T]
+        # reductions measure cheaper than one [T,T] all-reduce for the
+        # shallow conflict chains real batches carry (d is the chain
+        # depth, typically 1-3).
+        Of = O.astype(jnp.bfloat16)
+
+        def cond(carry):
+            _, changed = carry
+            return changed
+
+        def body(carry):
+            a, _ = carry
+            killed_local = jnp.dot(
+                a.astype(jnp.bfloat16), Of, preferred_element_type=jnp.float32
+            )
+            if axis_name is not None:
+                killed_local = jax.lax.psum(killed_local, axis_name)
+            killed = killed_local > 0.5
+            a_new = a0 & ~killed
+            return a_new, jnp.any(a_new != a)
+
+        accepted, _ = jax.lax.while_loop(cond, body, (a0, jnp.array(True)))
 
     status = jnp.where(too_old, TOO_OLD, jnp.where(accepted, COMMITTED, CONFLICT))
     status = jnp.where(batch.txn_mask, status, CONFLICT)
@@ -645,6 +683,15 @@ def validate_params(params: ResolverParams):
         )
     if params.bucket_bits > 30 or params.hash_bits > 28:
         raise ValueError("bucket_bits/hash_bits unreasonably large")
+    if params.use_pallas_scan:
+        from foundationdb_tpu.ops.pallas_scan import MAX_TXNS
+
+        if params.txns > MAX_TXNS:
+            raise ValueError(
+                f"use_pallas_scan requires txns <= {MAX_TXNS}: the fused "
+                "kernel's txn-tile loops unroll at trace time (got "
+                f"{params.txns})"
+            )
     pb = params.ring_partition_bits
     if pb:
         if pb > params.bucket_bits:
@@ -657,12 +704,12 @@ def validate_params(params: ResolverParams):
                 "ring_capacity must divide evenly into 2^ring_partition_bits "
                 "sub-rings"
             )
-        if params.use_pallas:
+        if params.use_pallas or params.use_pallas_scan:
             raise ValueError(
-                "ring_partition_bits and use_pallas are mutually "
-                "exclusive: the Pallas VMEM kernel implements the FLAT "
-                "ring layout (silently ignoring the explicit pallas "
-                "request would misattribute benchmarks)"
+                "ring_partition_bits and use_pallas/use_pallas_scan are "
+                "mutually exclusive: the Pallas VMEM kernels implement "
+                "the FLAT ring layout (silently ignoring the explicit "
+                "pallas request would misattribute benchmarks)"
             )
 
 
@@ -913,10 +960,10 @@ def validate_presharded_params(params: ResolverParams):
     T*RW <= KR wrap check does not apply: the kernel detects per-lane
     ring overflow at trace shapes and folds the excess into the coarse
     summaries instead of wrapping."""
-    if params.use_pallas:
+    if params.use_pallas or params.use_pallas_scan:
         raise ValueError(
-            "presharded resolve has no Pallas ring lane: the VMEM kernel "
-            "implements the dense [T, K] layout (silently ignoring the "
+            "presharded resolve has no Pallas lanes: the VMEM kernels "
+            "implement the dense [T, K] layout (silently ignoring the "
             "explicit pallas request would misattribute benchmarks)"
         )
     if params.ring_partition_bits:
@@ -969,6 +1016,10 @@ def make_resolve_scan_fn(params: ResolverParams, donate=True,
 
     Semantics are identical to calling ``resolve_batch`` B times in order
     — the scan carry is the same sequential state dependency — but one
+    dispatch covers the stack. ``use_pallas_scan`` is NOT stripped: the
+    fused accept kernel replaces the whole step body (ring + intra-batch
+    + acceptance), so there is no jnp/pallas split for XLA to schedule
+    around — the scan path keeps it whenever the params carry it. One
     dispatch amortizes the host→device launch cost across B batches,
     which dominates when the host link is high-latency (remote TPU) and
     still saves ~dispatch-overhead×B on local chips. This is the proxy's

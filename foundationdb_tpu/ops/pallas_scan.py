@@ -1,0 +1,319 @@
+"""Fused Pallas TPU kernel for the resolver's whole per-batch accept step.
+
+``ops/pallas_ring.py`` moved ONE lane — the query-vs-ring overlap check —
+into VMEM; everything else (the four intra-batch segment-intersection
+lanes, the Jacobi acceptance loop) still runs as jit'd jnp, streaming
+``[T, S, T, S]`` broadcast intermediates through HBM. This kernel fuses
+the complete accept decision into a single ``pallas_call``:
+
+1. **Ring phase** — each txn tile's point reads / range reads checked
+   against the committed range-write ring (the exact lane of
+   ConflictSet::detectConflicts, fdbserver/SkipList.cpp), tiled TK
+   entries at a time with only the per-txn kill bit kept.
+2. **Intra-batch phase** — the strict-lower conflict relation O[w, r]
+   ("an accepted earlier txn w's writes hit txn r's reads": point×point
+   via the fnv hash lanes, point×range / range×range via the W-limb
+   lexicographic compares shared with pallas_ring), computed per
+   128×128 tile pair ON THE FLY — no [T, T] matrix ever materializes.
+3. **Acceptance** — greedy sequential acceptance, computed directly:
+   tiles resolve in txn order, earlier tiles' final verdict bits feed
+   later tiles' kill masks. The jnp path's Jacobi iteration converges to
+   the greedy assignment as its unique fixpoint (induction on txn
+   index), so the two paths are bit-identical — which is what the
+   interpreter-mode differential tests pin.
+
+Layout: txns are padded to ``nt = ceil(T/128)`` tiles of 128 lanes; keys
+arrive ``[S, nt, W, 128]`` (slot, tile, limb, lane) so every in-kernel
+load is a static-index ``[W, 128]`` block with the lane axis minor, and
+all compares run in the same sign-flipped int32 space as ``pallas_ring``
+(the VPU is an int32 machine). Only the ``[nt, 128]`` verdict bits leave
+the kernel; the history epilogue (hash-table scatter, ring append,
+coarse summaries) stays in the shared jnp code of ``resolve_batch`` —
+identical on both routes by construction.
+
+On non-TPU backends the kernel runs in interpreter mode: bit-identical,
+slow, exactly what the tier-1 differential fixtures want. Lowering
+failures on real hardware fall back through the resolver's
+``pallas_to_jit`` taxonomy like the ring kernel's do.
+"""
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from foundationdb_tpu.ops.pallas_ring import (
+    LANES,
+    _pad_axis,
+    _pairwise_lex,
+    _signed,
+)
+
+# Static trace bound: the txn-tile loops unroll at trace time, so T is
+# capped at MAX_TXNS (nt <= 8 tiles). validate_params rejects larger
+# configs before a kernel is ever built.
+MAX_TXNS = 1024
+
+RING_TILE = 512  # TK: ring entries per VMEM block (matches pallas_ring)
+
+
+class _ScanCfg(NamedTuple):
+    """Static kernel config (closed over via functools.partial)."""
+
+    key_width: int  # W limbs per key
+    nt: int  # txn tiles of 128 lanes
+    nk: int  # ring tiles
+    ring_tile: int  # TK entries per ring tile
+    pr: int  # point-read slots per txn (>=1; dummies masked)
+    pw: int  # point-write slots per txn
+    rr: int  # range-read slots per txn
+    rw: int  # range-write slots per txn
+    pp: bool  # point-write × point-read hash lane
+    p_rr: bool  # point-write × range-read lane
+    rw_p: bool  # range-write × point-read lane
+    rw_rr: bool  # range-write × range-read lane
+    pr_ring: bool  # point reads vs the committed ring
+    rr_ring: bool  # range reads vs the committed ring
+
+
+def _scan_kernel(cfg, a0_ref, rv_ref, pwh_ref, prh_ref, pwk_ref, pwm_ref,
+                 prk_ref, prm_ref, rrb_ref, rre_ref, rrm_ref, rwb_ref,
+                 rwe_ref, rwm_ref, ringb_ref, ringe_ref, ringv_ref,
+                 ringm_ref, out_ref):
+    TQ, TK, W = LANES, cfg.ring_tile, cfg.key_width
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, TQ), 1).reshape(TQ)
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (TQ, TQ), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (TQ, TQ), 1)
+
+    def block(wt, rt):
+        """O tile [TQ, TQ]: does write-lane i (txn tile wt) conflict
+        with read-lane j (txn tile rt). Mirrors the four O |= lanes of
+        resolve_batch exactly; the strict-order and acceptance gating
+        happen at the call sites."""
+        blk = jnp.zeros((TQ, TQ), jnp.bool_)
+        if cfg.pp:
+            # masks ride the sentinel hashes (masked write → 0xFFFFFFFF,
+            # masked read → 0xFFFFFFFE, never equal) — same encoding as
+            # the jnp lane, so hash collisions resolve identically
+            for s1 in range(cfg.pw):
+                wh = pwh_ref[s1, wt].reshape(TQ, 1)
+                for s2 in range(cfg.pr):
+                    blk |= wh == prh_ref[s2, rt].reshape(1, TQ)
+        if cfg.p_rr:
+            for s1 in range(cfg.pw):
+                k = pwk_ref[s1, wt]
+                wm = pwm_ref[s1, wt].reshape(TQ, 1) != 0
+                for s2 in range(cfg.rr):
+                    inr = _pairwise_lex(
+                        k, rre_ref[s2, rt], W, TQ, TQ, "lt"
+                    ) & ~_pairwise_lex(k, rrb_ref[s2, rt], W, TQ, TQ, "lt")
+                    blk |= inr & wm & (rrm_ref[s2, rt].reshape(1, TQ) != 0)
+        if cfg.rw_p:
+            for s1 in range(cfg.rw):
+                b, e = rwb_ref[s1, wt], rwe_ref[s1, wt]
+                wm = rwm_ref[s1, wt].reshape(TQ, 1) != 0
+                for s2 in range(cfg.pr):
+                    k = prk_ref[s2, rt]
+                    # point k in [b, e): rows are the writer lanes, so
+                    # "k >= b" reads as "NOT b > k" with b on the rows
+                    inr = _pairwise_lex(
+                        e, k, W, TQ, TQ, "gt"
+                    ) & ~_pairwise_lex(b, k, W, TQ, TQ, "gt")
+                    blk |= inr & wm & (prm_ref[s2, rt].reshape(1, TQ) != 0)
+        if cfg.rw_rr:
+            for s1 in range(cfg.rw):
+                b, e = rwb_ref[s1, wt], rwe_ref[s1, wt]
+                wm = rwm_ref[s1, wt].reshape(TQ, 1) != 0
+                for s2 in range(cfg.rr):
+                    ov = _pairwise_lex(
+                        e, rrb_ref[s2, rt], W, TQ, TQ, "gt"
+                    ) & _pairwise_lex(b, rre_ref[s2, rt], W, TQ, TQ, "lt")
+                    blk |= ov & wm & (rrm_ref[s2, rt].reshape(1, TQ) != 0)
+        return blk
+
+    for rt in range(cfg.nt):
+        a0_row = a0_ref[rt, :] != 0
+
+        # ── ring phase: kill txns whose reads hit a newer live ring write
+        if cfg.pr_ring or cfg.rr_ring:
+            rv_col = rv_ref[rt, :].reshape(TQ, 1)
+
+            def ring_body(kt, killed, rv_col=rv_col, rt=rt):
+                rb, re = ringb_ref[kt], ringe_ref[kt]
+                nl = (ringv_ref[kt].reshape(1, TK) > rv_col) & (
+                    ringm_ref[kt].reshape(1, TK) != 0
+                )
+                acc = killed
+                if cfg.pr_ring:
+                    for s in range(cfg.pr):
+                        q = prk_ref[s, rt]
+                        inr = _pairwise_lex(
+                            q, re, W, TQ, TK, "lt"
+                        ) & ~_pairwise_lex(q, rb, W, TQ, TK, "lt")
+                        acc = acc | (
+                            jnp.any(inr & nl, axis=1)
+                            & (prm_ref[s, rt] != 0)
+                        )
+                if cfg.rr_ring:
+                    for s in range(cfg.rr):
+                        ov = _pairwise_lex(
+                            rrb_ref[s, rt], re, W, TQ, TK, "lt"
+                        ) & _pairwise_lex(rre_ref[s, rt], rb, W, TQ, TK, "gt")
+                        acc = acc | (
+                            jnp.any(ov & nl, axis=1)
+                            & (rrm_ref[s, rt] != 0)
+                        )
+                return acc
+
+            a0_row = a0_row & ~jax.lax.fori_loop(
+                0, cfg.nk, ring_body, jnp.zeros((TQ,), jnp.bool_)
+            )
+
+        # ── cross-tile kills: earlier tiles' verdicts are FINAL (greedy
+        # order), so their accepted bits gate their conflict rows
+        killed = jnp.zeros((TQ,), jnp.bool_)
+        for wt in range(rt):
+            acc_w = (out_ref[wt, :] != 0).reshape(TQ, 1)
+            killed = killed | jnp.any(block(wt, rt) & acc_w, axis=0)
+
+        # ── diagonal tile: greedy sequential acceptance within the tile.
+        # O is strictly upper within a tile (earlier lane kills later),
+        # so each step only ever kills lanes not yet decided.
+        diag = block(rt, rt) & (row_i < col_i)
+        base = a0_row & ~killed
+
+        def greedy_body(t, kd, base=base, diag=diag):
+            is_t = lane == t
+            a_t = jnp.any(is_t & base & ~kd)
+            victims = jnp.any(diag & is_t.reshape(TQ, 1), axis=0)
+            return kd | (victims & a_t)
+
+        kd = jax.lax.fori_loop(
+            0, TQ, greedy_body, jnp.zeros((TQ,), jnp.bool_)
+        )
+        out_ref[rt, :] = (base & ~kd).astype(jnp.int32)
+
+
+def fused_accept(state, batch, params, a0, interpret=False):
+    """The fused accept decision: bool[T] accepted bits.
+
+    ``a0`` is the per-txn admissibility AFTER the jnp history lanes that
+    stay outside the kernel (hash table, coarse summaries, too_old,
+    txn_mask); this function folds in the exact ring check and the
+    intra-batch greedy acceptance — bit-identical to resolve_batch's
+    jnp ring lanes + Jacobi fixpoint. Traced code (called from inside
+    resolve_batch's jit region): no host calls.
+    """
+    T, W = params.txns, params.key_width
+    u32 = jnp.uint32
+    nt = -(-T // LANES)
+    KR = state.ring_v.shape[0]
+    PRn = batch.pr_hash.shape[1]
+    PWn = batch.pw_hash.shape[1]
+    RRn = batch.rr_b.shape[1]
+    RWn = batch.rw_b.shape[1]
+
+    # lane gating mirrors resolve_batch: a side is live iff its params
+    # gate AND its array width are nonzero (packers may statically
+    # zero-width lanes a workload never uses)
+    pp = bool(params.point_writes and params.point_reads and PWn and PRn)
+    p_rr = bool(params.point_writes and params.range_reads and PWn and RRn)
+    rw_p = bool(params.range_writes and params.point_reads and RWn and PRn)
+    rw_rr = bool(params.range_writes and params.range_reads and RWn and RRn)
+    pr_ring = bool(params.range_writes and params.point_reads and PRn and KR)
+    rr_ring = bool(params.range_writes and params.range_reads and RRn and KR)
+
+    # absent sides get ONE all-masked dummy slot so the kernel signature
+    # stays fixed; their lanes are statically off above, so the dummies
+    # are never even read
+    if PWn:
+        wh = jnp.where(batch.pw_mask, batch.pw_hash, u32(0xFFFFFFFF))
+        pwk, pwm = batch.pw_key, batch.pw_mask
+    else:
+        wh = jnp.full((T, 1), 0xFFFFFFFF, u32)
+        pwk = jnp.zeros((T, 1, W), u32)
+        pwm = jnp.zeros((T, 1), bool)
+    if PRn:
+        rh = jnp.where(batch.pr_mask, batch.pr_hash, u32(0xFFFFFFFE))
+        prk, prm = batch.pr_key, batch.pr_mask
+    else:
+        rh = jnp.full((T, 1), 0xFFFFFFFE, u32)
+        prk = jnp.zeros((T, 1, W), u32)
+        prm = jnp.zeros((T, 1), bool)
+    if RRn:
+        rrb, rre, rrm = batch.rr_b, batch.rr_e, batch.rr_mask
+    else:
+        rrb = rre = jnp.zeros((T, 1, W), u32)
+        rrm = jnp.zeros((T, 1), bool)
+    if RWn:
+        rwb, rwe, rwm = batch.rw_b, batch.rw_e, batch.rw_mask
+    else:
+        rwb = rwe = jnp.zeros((T, 1, W), u32)
+        rwm = jnp.zeros((T, 1), bool)
+
+    def tile_vec(x):  # int32-valued [T] → [nt, 128]
+        return _pad_axis(x.reshape(1, T), LANES, 1).reshape(nt, LANES)
+
+    def tile_slots(x):  # int32-valued [T, S] → [S, nt, 128]
+        return _pad_axis(x, LANES, 0).T.reshape(x.shape[1], nt, LANES)
+
+    def tile_keys(k):  # uint32 [T, S, W] → signed [S, nt, W, 128]
+        S = k.shape[1]
+        x = _pad_axis(_signed(k), LANES, 0)  # [Tp, S, W]
+        return x.transpose(1, 0, 2).reshape(S, nt, LANES, W).transpose(
+            0, 1, 3, 2
+        )
+
+    # ring layout: [nk, W, TK] / [nk, TK], lanes minor — same transform
+    # pallas_ring applies, plus the tile fold on the leading axis
+    if (pr_ring or rr_ring) and KR:
+        tk = min(RING_TILE, -(-KR // LANES) * LANES)
+        rgb = _pad_axis(_signed(state.ring_b), tk, 0)  # [KRp, W]
+        nk = rgb.shape[0] // tk
+        ringb = rgb.reshape(nk, tk, W).transpose(0, 2, 1)
+        ringe = _pad_axis(_signed(state.ring_e), tk, 0).reshape(
+            nk, tk, W
+        ).transpose(0, 2, 1)
+        ringv = _pad_axis(
+            _signed(state.ring_v).reshape(1, KR), tk, 1
+        ).reshape(nk, tk)
+        ringm = _pad_axis(
+            state.ring_mask.astype(jnp.int32).reshape(1, KR), tk, 1
+        ).reshape(nk, tk)
+    else:
+        tk, nk = LANES, 1
+        ringb = ringe = jnp.zeros((1, W, tk), jnp.int32)
+        ringv = jnp.zeros((1, tk), jnp.int32)
+        ringm = jnp.zeros((1, tk), jnp.int32)
+
+    cfg = _ScanCfg(
+        key_width=W, nt=nt, nk=nk, ring_tile=tk,
+        pr=prk.shape[1], pw=pwk.shape[1], rr=rrb.shape[1],
+        rw=rwb.shape[1], pp=pp, p_rr=p_rr, rw_p=rw_p, rw_rr=rw_rr,
+        pr_ring=pr_ring, rr_ring=rr_ring,
+    )
+    out = pl.pallas_call(
+        functools.partial(_scan_kernel, cfg),
+        out_shape=jax.ShapeDtypeStruct((nt, LANES), jnp.int32),
+        interpret=interpret,
+    )(
+        tile_vec(a0.astype(jnp.int32)),
+        tile_vec(_signed(batch.rv)),
+        tile_slots(_signed(wh)),
+        tile_slots(_signed(rh)),
+        tile_keys(pwk),
+        tile_slots(pwm.astype(jnp.int32)),
+        tile_keys(prk),
+        tile_slots(prm.astype(jnp.int32)),
+        tile_keys(rrb),
+        tile_keys(rre),
+        tile_slots(rrm.astype(jnp.int32)),
+        tile_keys(rwb),
+        tile_keys(rwe),
+        tile_slots(rwm.astype(jnp.int32)),
+        ringb, ringe, ringv, ringm,
+    )
+    return out.reshape(nt * LANES)[:T] != 0

@@ -146,6 +146,10 @@ class MeshResolver(Resolver):
             (2, 4, BACKLOG_B)
             if jax.default_backend() == "cpu" else (BACKLOG_B,)
         )
+        # the fused-scan ladder extension is single-device only (the
+        # mesh never carries a Pallas route), so the chunk bound stays
+        # at the classic BACKLOG_B
+        self._scan_max_backlog = self._scan_pad_buckets[-1]
         self.adopt_profile(self.profile)  # attach the packer hooks
 
     def _split_counted(self, stacked):

@@ -53,6 +53,11 @@ LOWER_BETTER = (
     # healthy run are regressions ("robustness_overhead_pct" already
     # resolves via "overhead_pct" above)
     "rpc_timeouts", "endpoints_failed", "backoff_retries",
+    # fused Pallas scan kernel (ISSUE 18): the per-batch kernel step
+    # wall ("kernel_step_ms" also resolves via "_ms" — this pins the
+    # intent if the unit ever changes) and any pallas→jnp retries
+    # recorded by the executed-route ledger are regressions
+    "kernel_step", "_fallbacks",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",
@@ -69,6 +74,9 @@ HIGHER_BETTER = (
     # sites under chaos is better exploration; fault_sites_total stays
     # neutral (the table growing is neither good nor bad per se)
     "fault_sites_fired", "fault_coverage",
+    # fused Pallas scan kernel (ISSUE 18): the chip-resident resolve
+    # rate — the 650k→1M headline — is higher-better
+    "device_kernel",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

@@ -110,6 +110,12 @@ class DeviceProfile:
         self.lane_dispatches = 0
         # fallback-cause taxonomy
         self.fallback_causes = {c: 0 for c in FALLBACK_CAUSES}
+        # kernel-route dispatch records: which per-batch step body
+        # actually executed ("pallas_scan" | "pallas_ring" | "jit"),
+        # counted per live batch served — the ground truth behind
+        # bench.py's pallas_kernel_step stamp (the params flag alone is
+        # the REQUEST; a silent pallas_to_jit fallback must flip it)
+        self.kernel_routes = {}
 
     # ── capture sites (all host-side, all gated) ──
 
@@ -149,6 +155,17 @@ class DeviceProfile:
         with self._lock:
             self.fallback_causes[cause] = (
                 self.fallback_causes.get(cause, 0) + int(n))
+
+    def record_kernel_route(self, route, n=1):
+        """One successful dispatch served by ``route`` (n = live
+        batches it carried). Recorded at the call sites' success edge
+        only — a dispatch that engaged the Pallas fallback records its
+        cause, not a route."""
+        if not _enabled:
+            return
+        with self._lock:
+            self.kernel_routes[route] = (
+                self.kernel_routes.get(route, 0) + int(n))
 
     def record_staging(self, hit):
         if not _enabled:
@@ -220,6 +237,7 @@ class DeviceProfile:
                 "lane_entries": list(other.lane_entries),
                 "lane_dispatches": other.lane_dispatches,
                 "fallback_causes": dict(other.fallback_causes),
+                "kernel_routes": dict(other.kernel_routes),
             }
         with self._lock:
             self.dispatches += o["dispatches"]
@@ -257,6 +275,8 @@ class DeviceProfile:
             for c, v in o["fallback_causes"].items():
                 self.fallback_causes[c] = (
                     self.fallback_causes.get(c, 0) + v)
+            for r, v in o["kernel_routes"].items():
+                self.kernel_routes[r] = self.kernel_routes.get(r, 0) + v
 
     def snapshot(self):
         """JSON-ready doc (sorted, stably rounded). ``pad_waste_pct``
@@ -311,6 +331,8 @@ class DeviceProfile:
                 "lane_skew_pct": lane_skew,
                 "fallback_causes": dict(sorted(
                     self.fallback_causes.items())),
+                "kernel_routes": dict(sorted(
+                    self.kernel_routes.items())),
             }
 
 

@@ -597,3 +597,28 @@ def test_pack_smoke_contract():
     assert out["pack_path"] == "flat"
     assert out["stage_pack_ms"] > 0
     assert out["value"] > 1.0, out  # flat must not be slower
+
+
+def test_kernel_smoke_contract():
+    """BENCH_MODE=kernel_smoke proves the fused Pallas scan kernel
+    (interpreter on cpu) resolves bit-identically to the jnp path on a
+    ycsb-shaped stream, and that pallas_kernel_step is stamped from the
+    EXECUTED route ledger, not the request."""
+    out = bench.run_kernel_smoke(cpu=True)
+    for key in ("metric", "value", "unit", "vs_baseline", "within_budget",
+                "parity", "pallas_kernel_step", "kernel_routes",
+                "pallas_to_jit_fallbacks", "pad_waste_pct",
+                "pad_waste_max_pct", "bucket_histogram", "kernel_step_ms",
+                "jit_step_ms", "device_kernel_txns_per_sec"):
+        assert key in out, key
+    assert out["metric"] == "kernel_smoke_parity"
+    assert out["parity"] is True
+    assert out["within_budget"] is True, out
+    # honest stamp: the kernel route actually executed, zero fallbacks
+    assert out["pallas_kernel_step"] is True
+    assert out["kernel_routes"].get("pallas_scan", 0) > 0
+    assert out["pallas_to_jit_fallbacks"] == 0
+    # satellite gate: the 2/4/8/16/32 ladder keeps pad waste bounded
+    assert out["pad_waste_pct"] <= out["pad_waste_max_pct"]
+    assert out["kernel_step_ms"] > 0
+    assert out["device_kernel_txns_per_sec"] > 0
