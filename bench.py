@@ -455,6 +455,21 @@ def measure_kernel_step_ms(ck, params, batch, n_short=8, n_long=40,
     return float(np.median(est))
 
 
+def _commit_rate_trend(history_doc):
+    """Last window's committed rate over the first BUSY window's, from
+    the metrics history (utils/timeseries.py). The very first window's
+    rate is 0 by construction (no prior sample to delta against), so
+    the baseline is the earliest window that saw commits. 1.0 when no
+    such pair exists — a flat trend, not a signal."""
+    rows = (history_doc.get("series", {}).get("counters", {})
+            .get("txn_committed") or [])
+    rates = [r["rate"] for r in rows]
+    base = next((r for r in rates[:-1] if r > 0), 0.0)
+    if base <= 0:
+        return 1.0
+    return round(rates[-1] / base, 3)
+
+
 def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
             n_proxies=None, tracing_sample_rate=None,
             batch_scheduling=None, txn_repair=None, retry_mode=None,
@@ -554,6 +569,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         # short window still collects a meaningful probe band
         health_probe_interval_s=float(
             env("BENCH_HEALTH_PROBE_INTERVAL", 1.0)),
+        # metrics history: half-second windows so a 2s smoke still
+        # retains a few (the default 1s cadence would cut ~1)
+        history_cadence_s=float(env("BENCH_HISTORY_CADENCE", 0.5)),
     )
     db = cluster.database()
     # warm the pipeline (first batch jit-compiles the resolver kernel,
@@ -728,6 +746,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     # cluster doctor (ISSUE 13): snapshot health BEFORE close() — the
     # verdict reads live role liveness, which close() tears down
     hdoc = cluster.health_status()
+    # metrics history (ISSUE 19): same timing constraint — the
+    # collector samples live role state, so snapshot before teardown
+    hist = cluster.history_status()
     rpc_ctr_1 = failuremon.monitor().counters()
     backoff_retries_1 = backoff_mod.retry_count()
     cluster.close()  # batcher + grv threads, pools, engine/WAL handles
@@ -848,6 +869,14 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "replication_lag_ms": hdoc["regions"].get(
             "replication_lag_ms", 0.0) or 0.0,
         "region_failovers": hdoc["regions"].get("failovers", 0),
+        # metrics history + flight recorder (ISSUE 19): windows the
+        # collector retained, black-box dumps triggered during the run,
+        # and the committed-rate trajectory (last window's rate over the
+        # first's — >1 means throughput was still climbing when the
+        # window closed, <1 means it decayed; 1.0 with <2 windows)
+        "history_windows": hist["windows"],
+        "flight_dumps": hist["flight"]["dumps"],
+        "commit_rate_trend": _commit_rate_trend(hist),
         # robustness stack (ISSUE 15): RPC deadline expiries, endpoints
         # the failure monitor marked failed, and jittered backoff sleeps
         # taken during the measured window — deltas, so an in-process
@@ -2118,6 +2147,68 @@ def run_health_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_history_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=history_smoke: the metrics-history collector's
+    overhead budget, measured — the ycsb e2e with the HistoryCollector
+    + flight recorder ENABLED vs the timeseries kill switch OFF,
+    interleaved pairs, median throughput each, ≤2% budget (the
+    metrics_smoke protocol). The enabled arm's retained windows /
+    flight dumps / commit-rate trend ride along so the smoke also
+    proves the collector actually cut windows under the measured
+    load."""
+    from foundationdb_tpu.utils import timeseries as timeseries_mod
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    # cut windows aggressively for the smoke: the default 1s cadence
+    # would retain ~2 windows over a 2s run — too few for a trend
+    os.environ.setdefault("BENCH_HISTORY_CADENCE", "0.25")
+    backend = "native"
+    runs = {True: [], False: []}
+    fields_on = None
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                timeseries_mod.set_enabled(on)
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    fields_on = r
+    finally:
+        timeseries_mod.set_enabled(True)
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_history_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "history_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": fields_on.get("platform"),
+        "history_windows": fields_on.get("history_windows"),
+        "flight_dumps": fields_on.get("flight_dumps"),
+        "commit_rate_trend": fields_on.get("commit_rate_trend"),
+        "health_verdict": fields_on.get("health_verdict"),
+        "commit_p50_ms": fields_on.get("commit_p50_ms"),
+        "commit_p99_ms": fields_on.get("commit_p99_ms"),
+        "grv_p99_ms": fields_on.get("grv_p99_ms"),
+    }
+
+
 def run_region_smoke(cpu, seconds=None, rounds=None):
     """BENCH_MODE=region_smoke: what multi-region replication costs the
     commit path, measured — interleaved rounds of the ycsb e2e with
@@ -3129,6 +3220,7 @@ def _compact_summary(out, configs):
               "fault_coverage_pct",
               "probe_grv_p99_ms", "probe_commit_p99_ms",
               "recovery_count", "last_recovery_ms", "health_verdict",
+              "history_windows", "flight_dumps", "commit_rate_trend",
               "region_mode", "replication_lag_ms", "region_failovers",
               "rpc_timeouts", "endpoints_failed", "backoff_retries",
               "tpu_recovered", "fallback_from", "error"):
@@ -3186,6 +3278,8 @@ def main():
     # enumerated in analysis/faultsites.txt) |
     # health_smoke (cluster-doctor overhead: latency prober + health
     # rollups on vs the health kill switch off, ≤2% budget) |
+    # history_smoke (metrics-history collector + flight recorder
+    # overhead: the timeseries kill switch on vs off, ≤2% budget) |
     # region_smoke (multi-region replication cost: regions off vs sync
     # vs async satellite mode, sync ≤15% budget, async lag measured) |
     # read_smoke (loaded read RTT: sync blocking get() vs get_async
@@ -3300,6 +3394,15 @@ def main():
 
     if mode == "health_smoke":
         out = run_health_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # same contract as metrics_smoke: the ≤2% budget is a GATE
+        if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "history_smoke":
+        out = run_history_smoke(cpu)
         watchdog_finish()
         _emit(out)
         # same contract as metrics_smoke: the ≤2% budget is a GATE

@@ -170,6 +170,37 @@ class Knobs:
     doctor_recovery_ms: float = 30_000.0
     doctor_lag_versions: int = 5_000_000
 
+    # --- metrics history + flight recorder (utils/timeseries.py) ---
+    # cluster-owned retention layer (ref: flow/TDMetric.actor.h
+    # continuous metric logging): one fixed-cadence window per interval
+    # samples every role registry, the heatmaps, the device profiles,
+    # the ratekeeper gauges, and the health verdict into bounded
+    # per-metric rings. Cadence rides the injected clock + the
+    # "history-cadence" deterministic stream (the FL001 seam, same as
+    # the latency prober); thread-mode clusters drive it from a daemon
+    # loop, sims call maybe_collect() from their own schedule.
+    history_enabled: bool = True
+    history_cadence_s: float = 1.0
+    history_windows: int = 64  # per-metric ring depth
+    history_heat_top: int = 8  # hot-range rows retained per dim/window
+    # flight recorder (the black box): verdict transitions, recovery
+    # triggers, and probe-SLO breaches dump a bounded artifact — last
+    # flight_windows windows + the trace-ring tail + the recovery
+    # timeline + activated SimBuggifySites — into an in-memory ring
+    # (the \xff\xff/status/flight special key) and, when flight_dir is
+    # set, as sorted-key flight-<seq>.json files (byte-identical under
+    # a sim seed — the chaos post-mortem contract)
+    flight_windows: int = 16
+    flight_trace_tail: int = 64
+    flight_max_dumps: int = 8
+    flight_dir: str = ""
+    # trend-aware doctor alerts (tools/doctor.py --trend + the
+    # probe_trend degraded reason): a probe p99 strictly rising across
+    # this many consecutive windows by at least this total percentage
+    # alerts BEFORE the instant doctor_probe_p99_ms threshold breaches
+    doctor_trend_windows: int = 3
+    doctor_trend_min_rise_pct: float = 5.0
+
     # --- multi-region replication (server/region.py) ---
     # continuous satellite streamer cadence: the RegionReplicator drains
     # the primary log toward the satellite at most once per interval

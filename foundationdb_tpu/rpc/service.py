@@ -129,6 +129,13 @@ class ClusterService:
             # device-path execution profile alone (fdbcli `profile`):
             # resolver dispatch/pad/fallback accounting + lane walls
             "device_profile": self.device_profile,
+            # metrics history: the retention layer's per-metric windows
+            # + verdict timeline alone (fdbcli `history`, the trend
+            # consumers in tools/doctor.py and tools/heatmap.py)
+            "history": self.history,
+            # flight recorder: dump summary + newest black-box artifact
+            # (tools/flight.py post-mortems against a live cluster)
+            "flight": self.flight,
             "get_read_version": self.get_read_version,
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
@@ -192,6 +199,12 @@ class ClusterService:
 
     def device_profile(self):
         return self.cluster.device_profile_status()
+
+    def history(self):
+        return self.cluster.history_status()
+
+    def flight(self):
+        return self.cluster.flight_status()
 
     def get_read_version(self, priority="default", tags=()):
         return self.cluster.grv_proxy.get_read_version(
@@ -1031,6 +1044,12 @@ class RemoteCluster:
 
     def device_profile_status(self):
         return self._call("device_profile")
+
+    def history_status(self):
+        return self._call("history")
+
+    def flight_status(self):
+        return self._call("flight")
 
     # management surface (the special key space's commit-time handles)
     def exclude_storage(self, sid):

@@ -32,6 +32,7 @@ from foundationdb_tpu.utils import deviceprofile
 from foundationdb_tpu.utils import heatmap as heatmap_mod
 from foundationdb_tpu.utils import lockdep
 from foundationdb_tpu.utils import metrics as metrics_mod
+from foundationdb_tpu.utils import timeseries as timeseries_mod
 from foundationdb_tpu.utils.trace import TraceEvent
 
 
@@ -245,6 +246,13 @@ class Cluster:
         self.clock_advance = None
         self.recovery_timeline = health_mod.RecoveryTimeline()
         self.prober = health_mod.LatencyProber(self)
+        # ── metrics history + flight recorder (utils/timeseries.py) ──
+        # the fourth member of the cluster-owned observability family
+        # (registries, heatmaps, device profiles → history rings): the
+        # collector samples the stores above each cadence window, so
+        # its windows inherit their survive-recovery/absorb-on-shrink
+        # semantics and never rewind
+        self.history = timeseries_mod.HistoryCollector(self)
         # multi-region replication (server/region.py): None until a
         # region config attaches; the frontend below reads it, so the
         # attribute must exist before _build_txn_frontend
@@ -274,6 +282,11 @@ class Cluster:
         # schedule so determinism is never perturbed
         if commit_pipeline == "thread" and knobs.health_probe_enabled:
             self.prober.start()
+        # the history collector follows the prober's driver split: a
+        # daemon loop only in thread mode, sim/manual schedules call
+        # maybe_collect() themselves
+        if commit_pipeline == "thread" and knobs.history_enabled:
+            self.history.start()
 
     def _restore_tenant_config(self):
         """Re-apply persisted tenant mode + quotas + lock state after
@@ -823,6 +836,7 @@ class Cluster:
         """Release background machinery (batcher threads, thread pools)
         and durable handles."""
         self.prober.stop()
+        self.history.stop()
         if self.regions is not None:
             self.regions.close()
         if hasattr(self.grv_proxy, "close"):
@@ -1467,6 +1481,23 @@ class Cluster:
         a pure read (no probe fires here)."""
         return health_mod.build_health(self)
 
+    def history_status(self):
+        """The metrics-history document (``history`` RPC /
+        \\xff\\xff/metrics/history / fdbcli history / cluster.history):
+        bounded per-metric rings of fixed-cadence windows — counter
+        rates, gauge trajectories, latency-band p99 trajectories, heat
+        totals, and the verdict timeline — plus the flight recorder's
+        summary. A pure read: no window is cut here."""
+        return self.history.status()
+
+    def flight_status(self):
+        """The flight-recorder document (``flight`` RPC /
+        \\xff\\xff/status/flight / tools/flight.py): the black box's
+        dump summary plus the newest retained artifact (None until a
+        verdict transition, recovery, or probe-SLO breach has fired)."""
+        return {**self.history.recorder.summary(),
+                "artifact": self.history.recorder.latest()}
+
     def _trace_status(self):
         """The trace/span pipeline's own health: per-type suppression
         (satellite of flow/Trace.cpp event suppression) and the tracing
@@ -1562,6 +1593,12 @@ class Cluster:
                 # the resolver dispatch layer's pad/bucket/fallback
                 # accounting, cluster-owned like metrics/heatmaps above
                 "device": self.device_profile_status(),
+                # metrics history (utils/timeseries.py): the retention
+                # layer's full doc — bounded per-metric windows, the
+                # verdict timeline, and the flight-recorder summary —
+                # so status-file consumers (tools/doctor.py --trend)
+                # see trajectories without a second RPC
+                "history": self.history_status(),
                 # observability plumbing health: process-wide (cumulative
                 # across incarnations, so kept OUT of the deterministic
                 # per-cluster metrics section) — the trace sink's

@@ -276,6 +276,9 @@ _MESSAGES = {
                           "(target TPS squeezed below capacity).",
     "probe_failures": "The most recent latency probe failed; the "
                       "transaction path may be impaired.",
+    "probe_trend": "A latency probe p99 is rising monotonically across "
+                   "consecutive history windows; latency is trending "
+                   "toward the SLO threshold before breaching it.",
     "region_lag": "Remote-region replication lag exceeds the doctor "
                   "threshold; a failover now would lose that much.",
     "region_replication_broken": "Region replication lost log "
@@ -400,6 +403,15 @@ def build_health(cluster):
     }
     if probe_doc["last_error"] is not None:
         degraded.add("probe_failures")
+    # ── trend-aware early warning (utils/timeseries.py) ──
+    # a probe p99 rising monotonically across doctor_trend_windows
+    # history windows degrades the verdict BEFORE the instant
+    # doctor_probe_p99_ms threshold breaches — the trend-consuming
+    # doctor alert ROADMAP item 4's admission control will act on
+    hist = getattr(cluster, "history", None)
+    trend_alerts = hist.trend_alerts() if hist is not None else []
+    if trend_alerts:
+        degraded.add("probe_trend")
     if unavailable:
         verdict, reasons = "unavailable", unavailable | degraded
     elif degraded:
@@ -421,6 +433,7 @@ def build_health(cluster):
              "description": _MESSAGES.get(r, r)} for r in reasons
         ],
         "probe": probe_doc,
+        "trend_alerts": trend_alerts,
         "recovery": rec,
         "lag": {
             "durability_lag_versions_max": lag_max,

@@ -348,6 +348,21 @@ class Ratekeeper:
         self.max_tps = float(tps)
         self.target_tps = min(self.target_tps, self.max_tps)
 
+    def history_sample(self):
+        """Point-in-time admission gauges for the history collector
+        (utils/timeseries.py): the trajectory inputs ROADMAP item 4's
+        admission control will trend on. Unlike ``status()`` this
+        mutates nothing — sampling a window must not dirty the
+        registry gauges other readers snapshot."""
+        with self._mu:
+            return {
+                "target_tps": round(self.target_tps, 2),
+                "saturation": round(
+                    1.0 - self.target_tps / max(self.max_tps, 1e-9), 4),
+                "throttled": self.throttled_count,
+                "tag_throttled": self.tag_throttled_count,
+            }
+
     def status(self):
         """This role's status RPC payload: the throttle gauges (leaf of
         the status doc). Gauges are refreshed here rather than on every

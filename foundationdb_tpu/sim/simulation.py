@@ -200,6 +200,13 @@ class Simulation:
         # timeline's per-phase durations come out nonzero and replay
         # byte-identically under a seed
         self.cluster.clock_advance = self._advance_clock
+        # the flight recorder's black-box artifacts carry WHICH buggify
+        # sites the seed activated (the repro line): hand the cluster a
+        # provider. Tests may swap self.buggify for a wrapper fn, so
+        # the hookup is best-effort, like the SimBuggifySites event.
+        sites = getattr(self.buggify, "activated_sites", None)
+        if sites is not None:
+            self.cluster.buggify_sites = sites
         self.cluster.commit_proxy = FaultyCommitProxy(
             self.cluster.commit_proxy, self.buggify
         )
@@ -277,6 +284,13 @@ class Simulation:
             reg = self.cluster.regions
             if reg is not None:
                 reg.maybe_stream()
+            # metrics history: the sim scheduler drives the collector's
+            # fixed-cadence windows exactly where a thread deployment's
+            # daemon loop would — cadence off the injected clock + the
+            # "history-cadence" deterministic stream, so same-seed runs
+            # cut identical windows (and the flight recorder dumps
+            # identical artifacts)
+            self.cluster.history.maybe_collect()
         self._actors = []
         # surface WHICH buggify sites this seed activated: a failing
         # seed's repro starts from this line (and a same-seed rerun

@@ -58,6 +58,13 @@ LOWER_BETTER = (
     # intent if the unit ever changes) and any pallas→jnp retries
     # recorded by the executed-route ledger are regressions
     "kernel_step", "_fallbacks",
+    # flight recorder (ISSUE 19): more black-box dumps during a bench
+    # run means more verdict flaps / recoveries / SLO breaches —
+    # a regression ("history_overhead_pct" already resolves via
+    # "overhead_pct"; "commit_rate_trend" resolves higher-better via
+    # "commit_rate" below, which is the intent: a decaying trajectory
+    # shrinking toward 0 is the regression signature)
+    "flight_dumps",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",
@@ -77,6 +84,10 @@ HIGHER_BETTER = (
     # fused Pallas scan kernel (ISSUE 18): the chip-resident resolve
     # rate — the 650k→1M headline — is higher-better
     "device_kernel",
+    # metrics history (ISSUE 19): retaining more windows over the same
+    # run means the collector kept cutting on cadence — fewer would
+    # mean stalls or a silently disabled collector
+    "history_windows",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

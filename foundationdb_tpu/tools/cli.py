@@ -140,6 +140,7 @@ class Cli:
             "  top [conflict|read|write] [K]   hottest key ranges + tags",
             "  profile [json]                  device-path dispatch profile",
             "  doctor [json]                   health verdict + SLO alerts",
+            "  history [METRIC|json]           metrics history windows",
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
             "  configure commit_proxies=N resolvers=N   live resize",
@@ -267,6 +268,22 @@ class Cli:
             f"  Committed           - {w['committed']['counter']}",
             f"  Conflicted          - {w['conflicted']['counter']}",
         )
+        # live rates from the metrics-history windows (the delta between
+        # the two most recent samples), not lifetime-counter averages —
+        # a cluster that was busy an hour ago and idle now shows ~0
+        from foundationdb_tpu.utils import timeseries as ts_mod
+
+        hist = c.get("history") or {}
+        if hist.get("windows", 0) >= 2:
+            rates = ts_mod.live_rates(hist)
+            self._p(
+                "Rates (last history window):",
+                f"  Committed tx/s      - "
+                f"{rates.get('txn_committed', 0.0)}",
+                f"  Reads/s             - {rates.get('reads', 0.0)}",
+                f"  Conflicts/s         - "
+                f"{rates.get('txn_conflicted', 0.0)}",
+            )
         # latency rollups from the metrics subsystem (ref: the latency
         # probe section of fdbcli status)
         roll = c.get("metrics", {}).get("rollups", {})
@@ -661,6 +678,80 @@ class Cli:
                 f"recompiles={r.get('recompiles')}{lane_note}"
             )
 
+
+    def _cmd_history(self, args):
+        """Metrics history (ref: the TDMetric channels fdbcli status
+        reads back over time): the retention layer's bounded windows —
+        counter rates, gauge rollups, latency p99 trajectories, and the
+        verdict timeline — read through the
+        ``\\xff\\xff/metrics/history`` special key so the same command
+        works against remote clusters. With METRIC, prints that one
+        series' full trajectory."""
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        doc = json.loads(self._run(lambda tr: tr.get(sk.HISTORY)))
+        if args and args[0] == "json":
+            self._p(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        if not doc.get("enabled", True):
+            self._p("Metrics history is disabled")
+        series = doc.get("series", {})
+        if args:
+            name = args[0]
+            rows = series.get("counters", {}).get(name)
+            if rows is not None:
+                for r in rows:
+                    self._p(f"  t={r['t']}: rate={r['rate']}/s "
+                            f"(total {r['total']})")
+                return
+            g = series.get("gauges", {}).get(name)
+            if g is not None:
+                for r in g.get("windows", ()):
+                    self._p(f"  t={r['t']}: {r['value']}")
+                self._p(f"  last={g['last']} min={g['min']} "
+                        f"max={g['max']}")
+                return
+            rows = series.get("latency_p99_ms", {}).get(name)
+            if rows is not None:
+                for r in rows:
+                    self._p(f"  t={r['t']}: p99={r['p99_ms']} ms")
+                return
+            known = sorted(
+                list(series.get("counters", {}))
+                + list(series.get("gauges", {}))
+                + list(series.get("latency_p99_ms", {})))
+            self._p(f"ERROR: no metric `{name}'. Known: "
+                    + ", ".join(known))
+            return
+        self._p(
+            f"History: {doc.get('windows', 0)} window(s) retained "
+            f"of {doc.get('capacity', 0)} "
+            f"(cadence {doc.get('cadence_s', 0.0)}s, "
+            f"{doc.get('windows_collected', 0)} collected)"
+        )
+        counters = series.get("counters", {})
+        if counters:
+            self._p("Rates (last window, /s):")
+            for name, rows in sorted(counters.items()):
+                if rows:
+                    self._p(f"  {name:<22}- {rows[-1]['rate']}")
+        lats = series.get("latency_p99_ms", {})
+        if lats:
+            self._p("Latency p99 (last window, ms):")
+            for name, rows in sorted(lats.items()):
+                if rows:
+                    self._p(f"  {name:<22}- {rows[-1]['p99_ms']}")
+        for a in doc.get("trend_alerts", ()):
+            self._p(f"  TREND {a['name']}: {a['from_ms']} -> "
+                    f"{a['to_ms']} ms (+{a['rise_pct']}% over "
+                    f"{a['windows']} windows)")
+        for tr_ in doc.get("transitions", ()):
+            self._p(f"  verdict @ t={tr_['t']}: {tr_['from']} -> "
+                    f"{tr_['to']}")
+        fl = doc.get("flight", {})
+        if fl.get("dumps"):
+            self._p(f"Flight recorder: {fl['dumps']} dump(s), last "
+                    f"triggers {fl.get('last_triggers')}")
 
     def _cmd_doctor(self, args):
         """Cluster doctor (ref: the health checks operators run through

@@ -32,6 +32,38 @@ DEFAULT_THRESHOLDS = {
 }
 
 
+def trend_check(history_doc, windows=None, min_rise_pct=None):
+    """Early-warning alerts from the metrics-history document
+    (utils/timeseries.py): a probe p99 rising monotonically across
+    consecutive windows alerts BEFORE the instant SLO threshold
+    breaches. Pure like ``check()`` — same doc, same alerts."""
+    from foundationdb_tpu.utils import timeseries as ts_mod
+
+    hits = ts_mod.trend_alerts_from_doc(
+        history_doc,
+        windows=windows or DEFAULT_KNOBS.doctor_trend_windows,
+        min_rise_pct=(min_rise_pct if min_rise_pct is not None
+                      else DEFAULT_KNOBS.doctor_trend_min_rise_pct),
+    )
+    return [
+        f"trend: probe {h['name']} p99 rising {h['from_ms']} -> "
+        f"{h['to_ms']}ms (+{h['rise_pct']}% over {h['windows']} windows)"
+        for h in hits
+    ]
+
+
+def extract_history(doc):
+    """Accept a bare history doc, a full status doc, or its ``cluster``
+    section — whichever the source produced."""
+    if not isinstance(doc, dict):
+        return {}
+    if "series" in doc:
+        return doc
+    if "cluster" in doc:
+        return doc["cluster"].get("history", {})
+    return doc.get("history", {})
+
+
 def check(health, thresholds=None):
     """One health document → ``(alerts, verdict)``. Pure and
     deterministic: the same doc and thresholds always yield the same
@@ -150,6 +182,11 @@ def main(argv=None, out=None, sleep=time.sleep):
     ap.add_argument("--lag-versions", type=int, default=None)
     ap.add_argument("--region-lag-versions", type=int, default=None)
     ap.add_argument("--failover-ms", type=float, default=None)
+    ap.add_argument("--trend", action="store_true",
+                    help="also scan the metrics history for monotone "
+                         "probe-p99 rises (alerts before the SLO breaks)")
+    ap.add_argument("--trend-windows", type=int, default=None)
+    ap.add_argument("--trend-min-rise-pct", type=float, default=None)
     ap.add_argument("--json", action="store_true", dest="as_json")
     ns = ap.parse_args(argv)
     thresholds = {
@@ -172,6 +209,12 @@ def main(argv=None, out=None, sleep=time.sleep):
         with open(ns.status_file) as f:
             return extract_health(json.load(f))
 
+    def poll_history():
+        if remote is not None:
+            return remote.history_status()
+        with open(ns.status_file) as f:
+            return extract_history(json.load(f))
+
     try:
         rounds = 1 if ns.watch is None else ns.watch
         n = 0
@@ -179,6 +222,10 @@ def main(argv=None, out=None, sleep=time.sleep):
         while True:
             health = poll()
             alerts, verdict = check(health, thresholds)
+            if ns.trend:
+                alerts = alerts + trend_check(
+                    poll_history(), ns.trend_windows,
+                    ns.trend_min_rise_pct)
             _report(health, alerts, verdict, ns.as_json, out)
             n += 1
             if rounds and n >= rounds:

@@ -1,0 +1,162 @@
+r"""Flight-recorder post-mortem: read the black box back.
+
+Ref parity: the operator workflow around FoundationDB incident
+forensics — trace logs plus the status history around the event. Here
+the input is a flight artifact (utils/timeseries.py FlightRecorder):
+the bounded dump a health-verdict transition, txn-system recovery, or
+probe-SLO breach produced, either as the JSON file written under
+``knobs.flight_dir`` or live off a cluster's ``flight`` RPC /
+``\xff\xff/status/flight`` special key::
+
+    python -m foundationdb_tpu.tools.flight --json flight-0000.json
+    python -m foundationdb_tpu.tools.flight --connect host:4500
+
+The report answers the first three incident questions: what tripped
+the recorder (triggers + verdict timeline), what the workload was
+doing (committed/conflict/read rate trends across the retained
+windows), and where the commit pipeline's time went (hottest-stage
+trajectory). Pure helpers (``rate_trends`` / ``hottest_stages`` /
+``verdict_timeline``) take the artifact dict directly so chaos tests
+can assert on them without a subprocess.
+"""
+
+import json
+import sys
+
+STAGES = ("pack", "dispatch", "resolve", "apply")
+
+
+def rate_trends(artifact, names=("txn_committed", "txn_conflicted",
+                                 "reads", "admit_denied")):
+    """{counter: [rate, ...]} across the artifact's retained windows —
+    the workload's shape leading into the incident."""
+    counters = (artifact.get("windows") or {}).get("counters") or {}
+    return {
+        name: [r["rate"] for r in counters.get(name) or []]
+        for name in names
+    }
+
+
+def hottest_stages(artifact):
+    """Per retained window, the commit-pipeline stage that burned the
+    most busy-time: ``[{t, stage, rate_s_per_s}, ...]``. The stage_*_s
+    counters are busy-SECONDS totals, so each window's rate is
+    seconds-per-second — directly comparable across stages."""
+    counters = (artifact.get("windows") or {}).get("counters") or {}
+    per_stage = {s: counters.get(f"stage_{s}_s") or [] for s in STAGES}
+    depth = max((len(rows) for rows in per_stage.values()), default=0)
+    out = []
+    for i in range(depth):
+        best, best_rate, t = None, -1.0, None
+        for stage, rows in per_stage.items():
+            if i < len(rows):
+                t = rows[i]["t"]
+                if rows[i]["rate"] > best_rate:
+                    best, best_rate = stage, rows[i]["rate"]
+        out.append({"t": t, "stage": best,
+                    "rate_s_per_s": round(max(best_rate, 0.0), 6)})
+    return out
+
+
+def verdict_timeline(artifact):
+    """[(t, verdict, reasons)] — the health trajectory the recorder
+    retained around the trigger."""
+    return [
+        (v["t"], v["verdict"], list(v.get("reasons") or ()))
+        for v in artifact.get("verdict_timeline") or []
+    ]
+
+
+def report(artifact, out=None):
+    """Human-readable post-mortem for one artifact."""
+    out = out if out is not None else sys.stdout
+
+    def p(line=""):
+        print(line, file=out)
+
+    p(f"Flight artifact seq={artifact.get('seq')} "
+      f"t={artifact.get('t')} generation={artifact.get('generation')}")
+    p(f"  verdict: {artifact.get('verdict')} "
+      f"reasons={artifact.get('reasons') or []}")
+    p(f"  triggers: {artifact.get('triggers') or []}")
+    if artifact.get("path"):
+        p(f"  path: {artifact['path']}")
+    p("Rate trends (per window, /s):")
+    for name, rates in sorted(rate_trends(artifact).items()):
+        if rates:
+            p(f"  {name:<16}- " + " ".join(str(r) for r in rates))
+    hs = hottest_stages(artifact)
+    if hs:
+        p("Hottest stage trajectory:")
+        for h in hs:
+            p(f"  t={h['t']}: {h['stage']} "
+              f"({h['rate_s_per_s']} busy-s/s)")
+    p("Verdict timeline:")
+    for t, verdict, reasons in verdict_timeline(artifact):
+        suffix = f" {reasons}" if reasons else ""
+        p(f"  t={t}: {verdict}{suffix}")
+    rec = artifact.get("recovery") or {}
+    if rec.get("records"):
+        p("Recovery timeline:")
+        for r in rec["records"]:
+            p(f"  gen {r.get('generation')}: {r.get('trigger')} "
+              f"({r.get('total_ms')} ms)")
+    sites = artifact.get("buggify_sites") or []
+    if sites:
+        p(f"Activated buggify sites: {', '.join(sites)}")
+    tail = artifact.get("trace_tail") or []
+    p(f"Trace tail: {len(tail)} event(s) retained")
+
+
+def _fetch_artifact(ns):
+    if ns.json == "-":
+        doc = json.load(sys.stdin)
+    elif ns.json:
+        with open(ns.json) as f:
+            doc = json.load(f)
+    else:
+        from foundationdb_tpu.rpc.service import RemoteCluster
+
+        rc = RemoteCluster([ns.connect])
+        try:
+            doc = rc.flight_status()
+        finally:
+            rc.close()
+    # the flight RPC / special key wraps the newest artifact in the
+    # dump summary; a flight_dir file IS the artifact
+    if isinstance(doc, dict) and "artifact" in doc \
+            and "flight_schema" not in doc:
+        return doc["artifact"], doc
+    return doc, None
+
+
+def main(argv=None, out=None):
+    import argparse
+
+    out = out if out is not None else sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.tools.flight",
+        description="post-mortem report over a flight-recorder artifact")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--json", metavar="PATH",
+                     help="a flight-NNNN.json artifact (- = stdin)")
+    src.add_argument("--connect", metavar="HOST:PORT",
+                     help="read the newest artifact off a live cluster")
+    ap.add_argument("--raw", action="store_true",
+                    help="dump the artifact JSON instead of the report")
+    ns = ap.parse_args(argv)
+    artifact, summary = _fetch_artifact(ns)
+    if artifact is None:
+        dumps = (summary or {}).get("dumps", 0)
+        print(f"No flight artifact recorded ({dumps} dumps).", file=out)
+        return 1
+    if ns.raw:
+        print(json.dumps(artifact, indent=2, sort_keys=True,
+                         default=repr), file=out)
+        return 0
+    report(artifact, out=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

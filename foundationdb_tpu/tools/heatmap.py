@@ -57,14 +57,10 @@ def split_points_from_rows(rows, n):
     return points
 
 
-def split_advice(doc, n=4, dim="read"):
-    """Advice record for one dimension of a workload-attribution
-    document: the suggested split keys plus the heat each resulting
-    shard would carry (so an operator can see HOW uneven the current
-    layout is versus the advised one)."""
-    rows = (doc.get("hot_ranges") or {}).get(dim) or []
-    points = split_points_from_rows(rows, n)
-    # heat per advised shard: rows partitioned at the split keys
+def shard_heat_at(rows, points):
+    """Heat per advised shard: snapshot ``rows`` partitioned at the
+    ``points`` split keys (a row belongs to the shard its begin key
+    falls in)."""
     shards = []
     acc = 0.0
     pi = 0
@@ -78,12 +74,49 @@ def split_advice(doc, n=4, dim="read"):
     while pi < len(points):  # trailing empty shards (dup-collapsed tail)
         shards.append(0.0)
         pi += 1
+    return shards
+
+
+def split_advice(doc, n=4, dim="read"):
+    """Advice record for one dimension of a workload-attribution
+    document: the suggested split keys plus the heat each resulting
+    shard would carry (so an operator can see HOW uneven the current
+    layout is versus the advised one)."""
+    rows = (doc.get("hot_ranges") or {}).get(dim) or []
+    points = split_points_from_rows(rows, n)
     return {
         "dim": dim,
         "n": n,
         "total_heat": round(sum(r["heat"] for r in rows), 4),
         "split_points": points,
-        "shard_heat": shards,
+        "shard_heat": shard_heat_at(rows, points),
+    }
+
+
+def heat_trend(history_doc, n=4, dim="read"):
+    """Per-advised-shard heat TRAJECTORY from the metrics-history
+    document (utils/timeseries.py): split points advised from the
+    NEWEST window's hot ranges, then every retained window's rows
+    partitioned at those same boundaries — so an operator sees whether
+    the advised split would have balanced the load over time or only
+    balances this instant's spike."""
+    windows = ((history_doc or {}).get("heat") or {}).get(dim) or []
+    if not windows:
+        return {"dim": dim, "n": n, "split_points": [], "windows": []}
+    # heat windows retain the top-K rows by heat; both the quantile
+    # walk and the partition need begin-key order
+    points = split_points_from_rows(
+        sorted(windows[-1]["rows"], key=lambda r: r["begin"]), n)
+    return {
+        "dim": dim,
+        "n": n,
+        "split_points": points,
+        "windows": [
+            {"t": w["t"], "total_heat": round(w["total"], 4),
+             "shard_heat": shard_heat_at(
+                 sorted(w["rows"], key=lambda r: r["begin"]), points)}
+            for w in windows
+        ],
     }
 
 
@@ -97,6 +130,10 @@ def _fetch_doc(ns):
 
     rc = RemoteCluster.from_cluster_file(ns.cluster_file)
     try:
+        # --trend consumes the history document (heat per window);
+        # the instant advice consumes the hot_ranges document
+        if ns.trend:
+            return rc.history_status()
         return rc.hot_ranges_status()
     finally:
         rc.close()
@@ -109,14 +146,23 @@ def main(argv=None):
         prog="heatmap", description="hot-range split-point advice")
     src = ap.add_mutually_exclusive_group(required=True)
     src.add_argument("--cluster-file", help="cluster to poll")
-    src.add_argument("--json", help="saved hot_ranges document (- = stdin)")
+    src.add_argument("--json", help="saved hot_ranges document "
+                                    "(- = stdin; with --trend: a saved "
+                                    "history document)")
     ap.add_argument("--dim", default="read",
                     choices=("conflict", "read", "write"))
     ap.add_argument("-n", type=int, default=4,
                     help="target shard count (n-1 split points)")
+    ap.add_argument("--trend", action="store_true",
+                    help="per-advised-shard heat trajectory from the "
+                         "metrics history instead of instant advice")
     ns = ap.parse_args(argv)
-    advice = split_advice(_fetch_doc(ns), n=ns.n, dim=ns.dim)
-    print(json.dumps(advice, indent=2))
+    doc = _fetch_doc(ns)
+    if ns.trend:
+        out = heat_trend(doc, n=ns.n, dim=ns.dim)
+    else:
+        out = split_advice(doc, n=ns.n, dim=ns.dim)
+    print(json.dumps(out, indent=2))
     return 0
 
 

@@ -45,6 +45,13 @@ HOT_RANGES = b"\xff\xff/metrics/hot_ranges"
 # dispatch/pad/fallback accounting + the cluster aggregate, without the
 # rest of the status doc — what `fdbcli profile` polls
 DEVICE = b"\xff\xff/metrics/device"
+# metrics history (utils/timeseries.py): bounded per-metric windows
+# (counter rates, gauge rollups, latency p99 trajectories) + verdict
+# timeline — what `fdbcli history` and the --trend tools poll
+HISTORY = b"\xff\xff/metrics/history"
+# flight recorder (utils/timeseries.py): dump summary + the newest
+# black-box artifact — what tools/flight.py reads from a live cluster
+FLIGHT = b"\xff\xff/status/flight"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
@@ -142,6 +149,32 @@ def _health_json(tr):
     return json.dumps(doc, sort_keys=True).encode()
 
 
+def _history_json(tr):
+    """The metrics-history document alone (per-metric windows, heat
+    trajectory, verdict timeline, trend alerts) — what `fdbcli history`
+    and the --trend modes of tools/doctor.py and tools/heatmap.py
+    poll."""
+    cluster = tr._cluster
+    if hasattr(cluster, "history_status"):
+        doc = cluster.history_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        doc = tr.db.status().get("cluster", {}).get("history", {})
+    return json.dumps(doc, sort_keys=True).encode()
+
+
+def _flight_json(tr):
+    """The flight-recorder document alone (dump summary + the newest
+    black-box artifact) — what tools/flight.py reads post-mortem."""
+    cluster = tr._cluster
+    if hasattr(cluster, "flight_status"):
+        doc = cluster.flight_status()
+    else:  # remote clusters without the endpoint: flight summary rides
+        # inside the history slice of the status doc; no artifact
+        hist = tr.db.status().get("cluster", {}).get("history", {})
+        doc = {**hist.get("flight", {}), "artifact": None}
+    return json.dumps(doc, sort_keys=True, default=repr).encode()
+
+
 def _tracing_rows(tr):
     """The tracing module's materialized rows (cluster config + this
     transaction's token), RYW-overlaid with pending tracing writes."""
@@ -195,6 +228,10 @@ def get(tr, key):
         return _hot_ranges_json(tr)
     if key == DEVICE:
         return _device_json(tr)
+    if key == HISTORY:
+        return _history_json(tr)
+    if key == FLIGHT:
+        return _flight_json(tr)
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
     if key == DB_LOCKED:
@@ -235,6 +272,10 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         rows.append((HOT_RANGES, get(tr, HOT_RANGES)))
     if begin <= DEVICE < end:
         rows.append((DEVICE, get(tr, DEVICE)))
+    if begin <= HISTORY < end:
+        rows.append((HISTORY, get(tr, HISTORY)))
+    if begin <= FLIGHT < end:
+        rows.append((FLIGHT, get(tr, FLIGHT)))
     if begin <= CONNECTION_STRING < end:
         rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
     rows += [

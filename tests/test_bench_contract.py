@@ -324,6 +324,31 @@ def test_health_smoke_contract():
     assert health_mod.enabled()
 
 
+def test_history_smoke_contract():
+    """BENCH_MODE=history_smoke: the metrics-history overhead probe
+    emits the budget fields plus the history-depth/flight/trend
+    observables from the enabled arm, and restores the kill switch.
+    One short round checks the contract; the bench run owns the
+    statistically serious comparison."""
+    out = bench.run_history_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "history_overhead_pct", "overhead_budget_pct",
+                "within_budget", "history_windows", "flight_dumps",
+                "commit_rate_trend", "health_verdict",
+                "commit_p50_ms", "commit_p99_ms", "grv_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_history_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # the enabled arm really collected windows off the injected cadence
+    assert out["history_windows"] >= 1
+    # a healthy smoke run never trips the flight recorder
+    assert out["health_verdict"] == "healthy"
+    # the probe restored the kill switch (history stays default-on)
+    from foundationdb_tpu.utils import timeseries as ts_mod
+
+    assert ts_mod.enabled()
+
+
 def test_region_smoke_contract():
     """BENCH_MODE=region_smoke: the three-arm probe (regions off vs
     sync vs async satellite mode) emits the overhead/budget fields plus

@@ -1,0 +1,577 @@
+"""Metrics history + flight recorder (ISSUE 19): bounded per-metric
+rings cut on the injected clock's cadence, window counters that survive
+recovery / resolver respawn / configure() shrink without rewinding, a
+flight recorder whose artifacts replay byte-identically across
+same-seed chaos sims, and the trend surfaces (probe_trend verdict
+reason, doctor --trend, heatmap --trend, fdbcli history)."""
+
+import io
+import json
+import os
+import random
+
+import pytest
+
+from foundationdb_tpu.core import deterministic
+from foundationdb_tpu.server.cluster import Cluster
+from foundationdb_tpu.server.kvstore import open_engine
+from foundationdb_tpu.tools import doctor, flight, heatmap
+from foundationdb_tpu.txn import specialkeys
+from foundationdb_tpu.utils import timeseries
+from tests.conftest import TEST_KNOBS
+
+
+def make_cluster(**kw):
+    kn = dict(TEST_KNOBS)
+    kn.setdefault("resolver_backend", "cpu")
+    kn.update(kw)
+    return Cluster(**kn)
+
+
+# ───────────────────────── per-metric rings ───────────────────────────
+class TestRings:
+    def test_counter_series_rates_and_bound(self):
+        s = timeseries.CounterSeries("c", capacity=3)
+        for t, total in ((0.0, 0), (1.0, 10), (2.0, 30), (3.0, 40)):
+            s.push(t, total, 1.0)
+        w = s.windows()
+        assert len(w) == 3  # bounded: the oldest window fell off
+        assert [r["rate"] for r in w] == [10.0, 20.0, 10.0]
+        assert [r["total"] for r in w] == [10.0, 30.0, 40.0]
+
+    def test_counter_series_never_rewinds(self):
+        # the one rewindable source: a freshly recruited storage's
+        # per-process registry restarts at zero — the high-water clamp
+        # turns that into a flat window, never a negative rate
+        s = timeseries.CounterSeries("c", 4)
+        s.push(0.0, 10, 1.0)
+        s.push(1.0, 3, 1.0)
+        w = s.windows()
+        assert w[-1]["total"] == 10.0
+        assert w[-1]["rate"] == 0.0
+        s.push(2.0, 12, 1.0)
+        assert s.windows()[-1]["rate"] == 2.0
+
+    def test_gauge_rollup(self):
+        g = timeseries.GaugeSeries("g", 4)
+        for t, v in ((0, 5.0), (1, 2.0), (2, 9.0)):
+            g.push(t, v)
+        assert g.rollup() == {"last": 9.0, "min": 2.0, "max": 9.0}
+        empty = timeseries.GaugeSeries("e", 4)
+        assert empty.rollup() == {"last": None, "min": None, "max": None}
+
+    def test_rising_p99_detects_monotone_rise_only(self):
+        rows = [{"p99_ms": v} for v in (10.0, 12.0, 15.0)]
+        hit = timeseries.rising_p99(rows, windows=3)
+        assert hit == {"from_ms": 10.0, "to_ms": 15.0, "rise_pct": 50.0,
+                       "windows": 3}
+        # non-monotone, too-short, zero-valued, and sub-threshold
+        # trajectories all stay quiet
+        assert timeseries.rising_p99(
+            [{"p99_ms": v} for v in (10, 15, 14)], 3) is None
+        assert timeseries.rising_p99(rows[:2], 3) is None
+        assert timeseries.rising_p99(
+            [{"p99_ms": v} for v in (0.0, 1.0, 2.0)], 3) is None
+        assert timeseries.rising_p99(
+            [{"p99_ms": v} for v in (100.0, 100.5, 101.0)], 3) is None
+
+    def test_trend_alerts_and_live_rates_from_doc(self):
+        doc = {"series": {
+            "counters": {"txn_committed": [
+                {"t": 0, "total": 0, "rate": 0.0},
+                {"t": 1, "total": 50, "rate": 50.0}]},
+            "latency_p99_ms": {
+                "probe_grv": [{"t": i, "p99_ms": 10.0 + 5 * i}
+                              for i in range(4)],
+                "probe_commit": [{"t": i, "p99_ms": 3.0}
+                                 for i in range(4)]},
+        }}
+        alerts = timeseries.trend_alerts_from_doc(doc)
+        assert [a["name"] for a in alerts] == ["probe_grv"]
+        assert timeseries.live_rates(doc) == {"txn_committed": 50.0}
+
+
+# ─────────────────────────── the collector ────────────────────────────
+class TestCollector:
+    def test_cadence_rides_the_injected_clock(self):
+        c = make_cluster(history_cadence_s=1.0)
+        t = [0.0]
+        deterministic.set_clock(lambda: t[0])
+        try:
+            # first call only arms the jittered schedule
+            assert c.history.maybe_collect() is False
+            t[0] += 10.0  # > cadence + max jitter
+            assert c.history.maybe_collect() is True
+            # rearmed in the future: an immediate re-poll must not fire
+            assert c.history.maybe_collect() is False
+            t[0] += 1.0
+            assert c.history.maybe_collect() is True
+            assert c.history_status()["windows"] == 2
+        finally:
+            deterministic.registry().reset_clock()
+            c.close()
+
+    def test_kill_switch_and_knob_disable(self):
+        c = make_cluster()
+        try:
+            c.history.collect_now()
+            timeseries.set_enabled(False)
+            assert c.history.maybe_collect() is False
+            st = c.history_status()
+            assert st["enabled"] is False
+            # collected windows stay readable while disabled
+            assert st["windows"] == 1
+        finally:
+            timeseries.set_enabled(True)
+            c.close()
+        c2 = make_cluster(history_enabled=False)
+        try:
+            assert c2.history.maybe_collect() is False
+            assert c2.history_status()["enabled"] is False
+        finally:
+            c2.close()
+
+    def test_windows_carry_commit_rates(self):
+        c = make_cluster(history_cadence_s=1.0)
+        t = [0.0]
+        deterministic.set_clock(lambda: t[0])
+        try:
+            db = c.database()
+            c.history.collect_now()
+            for i in range(5):
+                tr = db.create_transaction()
+                tr.set(b"k%d" % i, b"v")
+                tr.commit()
+            t[0] += 1.0
+            c.history.collect_now()
+            rows = c.history_status()["series"]["counters"][
+                "txn_committed"]
+            assert rows[-1]["rate"] == 5.0
+            assert rows[-1]["total"] >= 5.0
+        finally:
+            deterministic.registry().reset_clock()
+            c.close()
+
+    def test_status_doc_shape_and_surfaces(self):
+        c = make_cluster()
+        try:
+            db = c.database()
+            db[b"x"] = b"1"
+            c.history.collect_now()
+            st = c.history_status()
+            assert set(st) == {
+                "enabled", "cadence_s", "capacity", "windows",
+                "windows_collected", "series", "heat", "verdicts",
+                "transitions", "trend_alerts", "flight"}
+            assert set(st["series"]) == {"counters", "gauges",
+                                         "latency_p99_ms"}
+            assert set(st["heat"]) == set(timeseries.HEAT_DIMS)
+            assert st["verdicts"][-1]["verdict"] == "healthy"
+            # cluster.history rides the status document
+            assert c.status()["cluster"]["history"][
+                "windows_collected"] == 1
+            # the special keys serve the same documents, JSON-encoded
+            raw = db.run(lambda tr: tr.get(specialkeys.HISTORY))
+            assert json.loads(raw)["windows"] == 1
+            fdoc = json.loads(
+                db.run(lambda tr: tr.get(specialkeys.FLIGHT)))
+            assert set(fdoc) == {"dumps", "retained", "last_triggers",
+                                 "dir", "artifact"}
+            # special reads never add conflict ranges
+            tr = db.create_transaction()
+            tr.get(specialkeys.HISTORY)
+            tr.get(specialkeys.FLIGHT)
+            assert tr._read_conflicts == []
+        finally:
+            c.close()
+
+    def test_rpc_handlers_expose_history_and_flight(self):
+        from foundationdb_tpu.rpc.service import ClusterService
+
+        c = make_cluster()
+        try:
+            c.history.collect_now()
+            svc = ClusterService(c)
+            h = svc.handlers()
+            assert h["history"]()["windows"] == 1
+            assert h["flight"]()["dumps"] == 0
+        finally:
+            c.close()
+
+
+# ──────────── lifecycle: recovery / respawn / shrink ──────────────────
+def _counter_totals(cluster):
+    doc = cluster.history_status()["series"]["counters"]
+    return {name: rows[-1]["total"] for name, rows in doc.items()}
+
+
+def _assert_monotone(cluster):
+    """Every counter series' totals are non-decreasing across all
+    retained windows — the no-rewind contract."""
+    for name, rows in cluster.history_status()["series"][
+            "counters"].items():
+        totals = [r["total"] for r in rows]
+        assert totals == sorted(totals), (name, totals)
+
+
+@pytest.mark.parametrize("engine", ["memory", "redwood"])
+def test_recovery_carries_window_counters_forward(tmp_path, engine):
+    c = make_cluster(
+        storage_engines=[open_engine(engine, str(tmp_path / "s0"))],
+        history_cadence_s=1.0)
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        db = c.database()
+        for i in range(4):
+            db[b"k%d" % i] = b"v"
+        c.history.collect_now()
+        before = _counter_totals(c)
+        c.sequencer.kill()
+        assert ("txn-system", 0) in c.detect_and_recruit()
+        db[b"after"] = b"x"
+        t[0] += 1.0
+        c.history.collect_now()
+        after = _counter_totals(c)
+        # nothing rewound across the recovery, commits kept counting
+        assert after["txn_committed"] > before["txn_committed"]
+        assert after["recoveries"] == before["recoveries"] + 1
+        _assert_monotone(c)
+        # the recovery edge-triggered a flight dump
+        assert c.flight_status()["dumps"] >= 1
+        assert any(tr.startswith("recovery:")
+                   for tr in c.flight_status()["last_triggers"])
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+@pytest.mark.parametrize("engine", ["memory", "redwood"])
+def test_resolver_respawn_carries_window_counters_forward(
+        tmp_path, engine):
+    c = make_cluster(
+        storage_engines=[open_engine(engine, str(tmp_path / "s0"))],
+        n_resolvers=2, history_cadence_s=1.0)
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        db = c.database()
+        db[b"a"] = b"1"
+        c.history.collect_now()
+        before = _counter_totals(c)
+        c.resolvers[0].kill()
+        assert c.detect_and_recruit()
+        db[b"a"] = b"2"
+        t[0] += 1.0
+        c.history.collect_now()
+        after = _counter_totals(c)
+        assert after["txn_committed"] > before["txn_committed"]
+        assert after["device_dispatches"] >= before["device_dispatches"]
+        _assert_monotone(c)
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+@pytest.mark.parametrize("engine", ["memory", "redwood"])
+def test_configure_shrink_carries_window_counters_forward(
+        tmp_path, engine):
+    c = make_cluster(
+        storage_engines=[open_engine(engine, str(tmp_path / "s0"))],
+        n_commit_proxies=2, n_resolvers=2, history_cadence_s=1.0)
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        db = c.database()
+        for i in range(4):
+            db[b"s%d" % i] = b"v"
+        c.history.collect_now()
+        before = _counter_totals(c)
+        c.configure(commit_proxies=1, resolvers=1)
+        db[b"post"] = b"v"
+        t[0] += 1.0
+        c.history.collect_now()
+        after = _counter_totals(c)
+        # the orphaned members folded into member 0: nothing rewound
+        assert after["txn_committed"] > before["txn_committed"]
+        _assert_monotone(c)
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+# ─────────────────────── the flight recorder ──────────────────────────
+def test_verdict_transition_dumps_artifact(tmp_path):
+    c = make_cluster(history_cadence_s=1.0,
+                     flight_dir=str(tmp_path / "flight"))
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        c.history.collect_now()  # healthy baseline
+        c.sequencer.kill()
+        t[0] += 1.0
+        c.history.collect_now()
+        fl = c.flight_status()
+        assert fl["dumps"] == 1
+        art = fl["artifact"]
+        assert "verdict:healthy->unavailable" in art["triggers"]
+        assert art["verdict"] == "unavailable"
+        assert set(art) >= {
+            "flight_schema", "seq", "t", "triggers", "generation",
+            "verdict", "reasons", "windows", "verdict_timeline",
+            "recovery", "trace_tail", "buggify_sites", "path"}
+        # the file's bytes are path-free (the path is appended to the
+        # in-memory artifact only AFTER the write — same-seed runs into
+        # different dirs still write identical bytes)
+        on_disk = json.loads(open(art["path"]).read())
+        assert "path" not in on_disk
+        assert on_disk["triggers"] == art["triggers"]
+        # the transition also landed in the history timeline
+        assert c.history_status()["transitions"][-1]["to"] \
+            == "unavailable"
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+def test_probe_slo_breach_dumps_once_with_hysteresis(tmp_path):
+    # any nonzero probe p99 breaches a microscopic SLO; the second
+    # window must NOT dump again while the breach persists
+    c = make_cluster(history_cadence_s=1.0, doctor_probe_p99_ms=1e-6)
+    # probe on the real clock — a frozen clock would measure every
+    # probe at 0.0 ms and nothing could breach the SLO
+    assert c.prober.probe_now()
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        c.history.collect_now()
+        assert c.flight_status()["dumps"] == 1
+        assert any(tr.startswith("probe_slo:")
+                   for tr in c.flight_status()["last_triggers"])
+        t[0] += 1.0
+        c.history.collect_now()
+        assert c.flight_status()["dumps"] == 1  # still breached: armed
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+def test_artifact_ring_is_bounded(tmp_path):
+    c = make_cluster(history_cadence_s=1.0, flight_max_dumps=2)
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        for i in range(4):
+            # observe() rewrites _prev_verdict to the live (healthy)
+            # verdict each window, so re-arm a fake transition every
+            # iteration to force a dump per window
+            c.history.recorder._prev_verdict = "degraded"
+            t[0] += 1.0
+            c.history.collect_now()
+        fl = c.flight_status()
+        assert fl["dumps"] == 4
+        assert fl["retained"] == 2
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+# ─────────────── trend surfaces: verdict, doctor, heatmap ─────────────
+def test_probe_trend_degrades_the_verdict(tmp_path):
+    c = make_cluster()
+    try:
+        ls = timeseries.LatencySeries("probe_grv", 8)
+        for i, v in enumerate((10.0, 20.0, 30.0)):
+            ls.push(float(i), v)
+        c.history._latencies["probe_grv"] = ls
+        h = c.health_status()
+        assert "probe_trend" in h["reasons"]
+        assert h["verdict"] == "degraded"
+        assert h["trend_alerts"][0]["name"] == "probe_grv"
+        assert any(m["name"] == "probe_trend" for m in h["messages"])
+    finally:
+        c.close()
+
+
+def test_doctor_trend_flag_alerts_and_exits_nonzero(tmp_path):
+    hist = {"series": {"latency_p99_ms": {
+        "probe_commit": [{"t": i, "p99_ms": 5.0 + 2 * i}
+                         for i in range(4)]}}}
+    status = {"cluster": {"health": {"verdict": "healthy"},
+                          "history": hist}}
+    p = tmp_path / "status.json"
+    p.write_text(json.dumps(status))
+    out = io.StringIO()
+    rc = doctor.main(["--status-file", str(p), "--trend"], out=out)
+    assert rc == 1  # chainable: the rising trend alone gates
+    assert "trend: probe probe_commit" in out.getvalue()
+    # without --trend the same healthy doc passes
+    out2 = io.StringIO()
+    assert doctor.main(["--status-file", str(p)], out=out2) == 0
+
+
+def test_heatmap_trend_partitions_each_window_at_advised_splits():
+    def win(t, rows):
+        return {"t": t, "total": sum(r["heat"] for r in rows),
+                "rows": rows}
+
+    # split points come from the LAST window (the current hot shape):
+    # equal heat there cuts at "m"; earlier windows are re-partitioned
+    # at those same points so the trajectory is comparable
+    hist = {"heat": {"read": [
+        win(0.0, [{"begin": "a", "end": "b", "heat": 2.0},
+                  {"begin": "m", "end": "n", "heat": 6.0}]),
+        win(1.0, [{"begin": "a", "end": "b", "heat": 4.0},
+                  {"begin": "m", "end": "n", "heat": 4.0}]),
+    ]}}
+    trend = heatmap.heat_trend(hist, n=2, dim="read")
+    assert trend["split_points"] == ["m"]
+    assert [w["shard_heat"] for w in trend["windows"]] \
+        == [[2.0, 6.0], [4.0, 4.0]]
+    empty = heatmap.heat_trend({}, n=2, dim="read")
+    assert empty["windows"] == []
+
+
+def test_flight_cli_reports_trends_and_timeline(tmp_path):
+    c = make_cluster(history_cadence_s=1.0,
+                     flight_dir=str(tmp_path / "fl"))
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        db = c.database()
+        c.history.collect_now()
+        for i in range(3):
+            db[b"f%d" % i] = b"v"
+        c.sequencer.kill()
+        t[0] += 1.0
+        c.history.collect_now()
+        path = c.flight_status()["artifact"]["path"]
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+    out = io.StringIO()
+    assert flight.main(["--json", path], out=out) == 0
+    s = out.getvalue()
+    assert "Rate trends" in s
+    assert "Verdict timeline" in s
+    assert "verdict:healthy->unavailable" in s
+    # the pure helpers agree with the report
+    art = json.loads(open(path).read())
+    assert timeseries is not None
+    trends = flight.rate_trends(art)
+    assert trends["txn_committed"][-1] > 0
+    assert flight.hottest_stages(art)[-1]["stage"] in flight.STAGES
+
+
+def test_fdbcli_history_and_live_rate_status():
+    from foundationdb_tpu.tools.cli import Cli
+
+    c = make_cluster(history_cadence_s=1.0)
+    t = [0.0]
+    deterministic.set_clock(lambda: t[0])
+    try:
+        db = c.database()
+        c.history.collect_now()
+        for i in range(4):
+            db[b"c%d" % i] = b"v"
+        t[0] += 1.0
+        c.history.collect_now()
+        out = io.StringIO()
+        cli = Cli(db, out=out)
+        cli.run_command("history")
+        cli.run_command("history txn_committed")
+        cli.run_command("status")
+        s = out.getvalue()
+        assert "window(s) retained" in s
+        assert "rate=4.0/s" in s
+        # status derives live rates from the two most recent windows
+        assert "Committed tx/s      - 4.0" in s
+        # unknown metrics name the known ones instead of crashing
+        out2 = io.StringIO()
+        Cli(db, out=out2).run_command("history nope")
+        assert "no metric `nope'" in out2.getvalue()
+    finally:
+        deterministic.registry().reset_clock()
+        c.close()
+
+
+# ─────────────── same-seed chaos sims: the acceptance bar ─────────────
+def _run_chaos_sim(datadir, flight_dir):
+    from foundationdb_tpu.sim.simulation import Simulation
+    from foundationdb_tpu.sim.workloads import (
+        cycle_check, cycle_setup, cycle_workload,
+    )
+    from foundationdb_tpu.utils.trace import global_trace_log
+
+    # the artifact embeds the trace-ring tail: start each run from an
+    # empty ring so run order cannot leak into the bytes
+    global_trace_log().clear()
+    sim = Simulation(seed=7, crash_p=0.0, n_storage=2, n_tlogs=3,
+                     datadir=datadir, health_probe_interval_s=0.05,
+                     history_cadence_s=0.02, flight_dir=flight_dir)
+    n_nodes = 10
+    cycle_setup(sim.db, n_nodes)
+    sim.add_workload(
+        "c0", cycle_workload(sim.db, n_nodes, 25, random.Random(99)))
+
+    def prober_actor():
+        for _ in range(300):
+            sim.cluster.prober.maybe_probe()
+            yield
+
+    def killer():
+        for _ in range(40):
+            yield
+        if sim.cluster.sequencer.alive:
+            sim.cluster.sequencer.kill()
+        for _ in range(40):
+            yield
+
+    sim.add_workload("probe", prober_actor())
+    sim.add_workload("kill", killer())
+    sim.run()
+    sim.quiesce()
+    cycle_check(sim.db, n_nodes)
+    hist = sim.cluster.history_status()
+    fl = sim.cluster.flight_status()
+    hdoc = json.dumps(hist, sort_keys=True, default=repr)
+    adoc = json.dumps(fl["artifact"], sort_keys=True, default=repr)
+    files = sorted(os.listdir(flight_dir))
+    fbytes = {fn: open(os.path.join(flight_dir, fn), "rb").read()
+              for fn in files}
+    sim.close()
+    return hist, fl, hdoc, adoc, files, fbytes
+
+
+def test_same_seed_sims_emit_byte_identical_history_and_flight(
+        tmp_path):
+    """The ISSUE-19 acceptance bar: two same-seed chaos simulations
+    (sequencer killed mid-load, prober live, collector cutting windows
+    on the sim schedule) produce byte-identical history documents AND
+    flight artifacts — in memory and on disk. Both runs write into the
+    SAME flight dir (run B overwrites run A's files after their bytes
+    are captured) so even the embedded paths must agree."""
+    flight_dir = str(tmp_path / "flight")
+    a = _run_chaos_sim(str(tmp_path / "a"), flight_dir)
+    b = _run_chaos_sim(str(tmp_path / "b"), flight_dir)
+    assert a[2] == b[2]  # history doc, byte-identical
+    assert a[3] == b[3]  # newest artifact, byte-identical
+    assert a[4] == b[4] and a[5] == b[5]  # files on disk, byte-identical
+    hist, fl = a[0], a[1]
+    # the collector really cut windows under the simulated schedule
+    assert hist["windows"] > 3
+    assert hist["series"]["counters"]["txn_committed"][-1]["total"] > 0
+    # the injected kill really triggered the black box, and the
+    # artifact carries the seed's activated buggify sites (the repro)
+    assert fl["dumps"] >= 1
+    art = fl["artifact"]
+    assert any(t.startswith("recovery:") or t.startswith("verdict:")
+               for t in art["triggers"])
+    assert art["buggify_sites"]  # seed 7 activates at least one site
+    _assert_monotone_doc(hist)
+
+
+def _assert_monotone_doc(hist):
+    for name, rows in hist["series"]["counters"].items():
+        totals = [r["total"] for r in rows]
+        assert totals == sorted(totals), (name, totals)
