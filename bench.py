@@ -572,6 +572,10 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         # metrics history: half-second windows so a 2s smoke still
         # retains a few (the default 1s cadence would cut ~1)
         history_cadence_s=float(env("BENCH_HISTORY_CADENCE", 0.5)),
+        # continuous consistency scan: tight cadence so a short smoke
+        # window still completes rounds (scan_smoke measures overhead)
+        consistency_scan_interval_s=float(
+            env("BENCH_SCAN_INTERVAL", 0.25)),
     )
     db = cluster.database()
     # warm the pipeline (first batch jit-compiles the resolver kernel,
@@ -749,6 +753,9 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
     # metrics history (ISSUE 19): same timing constraint — the
     # collector samples live role state, so snapshot before teardown
     hist = cluster.history_status()
+    # continuous consistency scan: same timing constraint — the doc
+    # reads the live scanner, so snapshot before teardown
+    scan = cluster.consistency_scan_status()
     rpc_ctr_1 = failuremon.monitor().counters()
     backoff_retries_1 = backoff_mod.retry_count()
     cluster.close()  # batcher + grv threads, pools, engine/WAL handles
@@ -877,6 +884,15 @@ def run_e2e(cpu, mode=None, n_resolvers=None, backend="tpu", seconds=None,
         "history_windows": hist["windows"],
         "flight_dumps": hist["flight"]["dumps"],
         "commit_rate_trend": _commit_rate_trend(hist),
+        # continuous consistency scan (ISSUE 20): rounds completed,
+        # in-round progress, and confirmed inconsistencies on every e2e
+        # line — a scan that silently stops, or ever finds corruption,
+        # is a tracked regression (benchdiff: rounds higher-better,
+        # inconsistencies lower-better)
+        "scan_rounds": scan["round"],
+        "scan_progress_pct": scan["progress_pct"],
+        "scan_inconsistencies": scan["inconsistencies"],
+        "scan_round_ms": scan["last_round_ms"],
         # robustness stack (ISSUE 15): RPC deadline expiries, endpoints
         # the failure monitor marked failed, and jittered backoff sleeps
         # taken during the measured window — deltas, so an in-process
@@ -2209,6 +2225,69 @@ def run_history_smoke(cpu, seconds=None, rounds=None):
     }
 
 
+def run_scan_smoke(cpu, seconds=None, rounds=None):
+    """BENCH_MODE=scan_smoke: the continuous consistency scan's
+    overhead budget, measured — the ycsb e2e with the scanner ENABLED
+    vs its kill switch OFF, interleaved pairs, median throughput each,
+    ≤2% budget (the observability-smoke protocol). The enabled arm's
+    rounds completed / progress / inconsistencies ride along so the
+    smoke also proves the scanner actually walked the shard map under
+    the measured load — and that it confirmed ZERO inconsistencies on
+    a healthy cluster (any nonzero here is a false-positive bug)."""
+    from foundationdb_tpu.server import consistencyscan as scan_mod
+
+    env = os.environ.get
+    secs = seconds if seconds is not None \
+        else float(env("BENCH_SMOKE_SECONDS", 2))
+    rounds = rounds if rounds is not None \
+        else int(env("BENCH_SMOKE_ROUNDS", 3))
+    # scan aggressively for the smoke: the default 0.25s cadence with
+    # random arming could leave a 2s window with zero completed rounds
+    os.environ.setdefault("BENCH_SCAN_INTERVAL", "0.05")
+    backend = "native"
+    runs = {True: [], False: []}
+    fields_on = None
+    try:
+        for _ in range(rounds):
+            for on in (False, True):
+                scan_mod.set_enabled(on)
+                try:
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                except Exception as e:
+                    sys.stderr.write(f"native smoke failed ({e}); cpu\n")
+                    backend = "cpu"
+                    r = run_e2e(cpu, backend=backend, seconds=secs)
+                runs[on].append(r["e2e_committed_txns_per_sec"])
+                if on:
+                    fields_on = r
+    finally:
+        scan_mod.set_enabled(True)
+    v_on = float(np.median(runs[True]))
+    v_off = float(np.median(runs[False]))
+    overhead_pct = round(max(0.0, 1.0 - v_on / max(v_off, 1e-9)) * 100, 2)
+    return {
+        "metric": "e2e_scan_smoke",
+        "value": v_on,
+        "unit": "txns/sec",
+        "vs_baseline": round(v_on / BASELINE_TXNS_PER_SEC, 3),
+        "disabled_txns_per_sec": round(v_off, 1),
+        "scan_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "within_budget": overhead_pct <= 2.0,
+        "smoke_rounds": rounds,
+        "e2e_backend": backend,
+        "platform": fields_on.get("platform"),
+        "scan_rounds": fields_on.get("scan_rounds"),
+        "scan_progress_pct": fields_on.get("scan_progress_pct"),
+        "scan_inconsistencies": fields_on.get("scan_inconsistencies"),
+        "scan_round_ms": fields_on.get("scan_round_ms"),
+        "health_verdict": fields_on.get("health_verdict"),
+        "commit_p50_ms": fields_on.get("commit_p50_ms"),
+        "commit_p99_ms": fields_on.get("commit_p99_ms"),
+        "grv_p99_ms": fields_on.get("grv_p99_ms"),
+    }
+
+
 def run_region_smoke(cpu, seconds=None, rounds=None):
     """BENCH_MODE=region_smoke: what multi-region replication costs the
     commit path, measured — interleaved rounds of the ycsb e2e with
@@ -3221,6 +3300,7 @@ def _compact_summary(out, configs):
               "probe_grv_p99_ms", "probe_commit_p99_ms",
               "recovery_count", "last_recovery_ms", "health_verdict",
               "history_windows", "flight_dumps", "commit_rate_trend",
+              "scan_rounds", "scan_progress_pct", "scan_inconsistencies",
               "region_mode", "replication_lag_ms", "region_failovers",
               "rpc_timeouts", "endpoints_failed", "backoff_retries",
               "tpu_recovered", "fallback_from", "error"):
@@ -3280,6 +3360,8 @@ def main():
     # rollups on vs the health kill switch off, ≤2% budget) |
     # history_smoke (metrics-history collector + flight recorder
     # overhead: the timeseries kill switch on vs off, ≤2% budget) |
+    # scan_smoke (continuous consistency scan overhead: the scanner's
+    # kill switch on vs off, ≤2% budget, 0 inconsistencies expected) |
     # region_smoke (multi-region replication cost: regions off vs sync
     # vs async satellite mode, sync ≤15% budget, async lag measured) |
     # read_smoke (loaded read RTT: sync blocking get() vs get_async
@@ -3403,6 +3485,15 @@ def main():
 
     if mode == "history_smoke":
         out = run_history_smoke(cpu)
+        watchdog_finish()
+        _emit(out)
+        # same contract as metrics_smoke: the ≤2% budget is a GATE
+        if not out["within_budget"]:
+            sys.exit(1)
+        return
+
+    if mode == "scan_smoke":
+        out = run_scan_smoke(cpu)
         watchdog_finish()
         _emit(out)
         # same contract as metrics_smoke: the ≤2% budget is a GATE

@@ -201,6 +201,25 @@ class Knobs:
     doctor_trend_windows: int = 3
     doctor_trend_min_rise_pct: float = 5.0
 
+    # --- continuous consistency scan (server/consistencyscan.py) ---
+    # cluster-owned background replica auditor (ref: fdbserver/
+    # ConsistencyScan.actor.cpp): walks the shard map in bounded
+    # key-batches at pinned read versions, compares every live replica
+    # in the owning team, and re-reads once against the live map before
+    # declaring corruption. Cadence rides the injected clock + the
+    # "consistency-scan" deterministic stream (the FL001 seam, same as
+    # the latency prober); thread-mode clusters drive it from a daemon
+    # loop, sims call maybe_scan() from their own schedule.
+    consistency_scan_enabled: bool = True
+    consistency_scan_interval_s: float = 0.25
+    consistency_scan_batch_keys: int = 256
+    # sustained read budget: the next batch is deferred until the bytes
+    # the last one read have drained at this rate (0 = unpaced)
+    scan_rate_bytes_per_s: float = 2_000_000.0
+    # doctor --scan SLO: a completed round older than this — or any
+    # confirmed inconsistency — exits 1 (tools/doctor.py)
+    doctor_scan_max_round_age_s: float = 600.0
+
     # --- multi-region replication (server/region.py) ---
     # continuous satellite streamer cadence: the RegionReplicator drains
     # the primary log toward the satellite at most once per interval

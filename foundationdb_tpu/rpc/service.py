@@ -136,6 +136,11 @@ class ClusterService:
             # flight recorder: dump summary + newest black-box artifact
             # (tools/flight.py post-mortems against a live cluster)
             "flight": self.flight,
+            # continuous consistency scan: round/progress/verdict alone
+            # (fdbcli `scan status`, tools/doctor.py --scan), plus the
+            # kill-switch control behind fdbcli `scan on|off`
+            "consistency_scan": self.consistency_scan,
+            "set_consistency_scan": self.set_consistency_scan,
             "get_read_version": self.get_read_version,
             "storage_get": self.storage_get,
             "resolve_selector": self.resolve_selector,
@@ -205,6 +210,12 @@ class ClusterService:
 
     def flight(self):
         return self.cluster.flight_status()
+
+    def consistency_scan(self):
+        return self.cluster.consistency_scan_status()
+
+    def set_consistency_scan(self, on):
+        return self.cluster.set_consistency_scan(bool(on))
 
     def get_read_version(self, priority="default", tags=()):
         return self.cluster.grv_proxy.get_read_version(
@@ -1050,6 +1061,12 @@ class RemoteCluster:
 
     def flight_status(self):
         return self._call("flight")
+
+    def consistency_scan_status(self):
+        return self._call("consistency_scan")
+
+    def set_consistency_scan(self, on):
+        return self._call("set_consistency_scan", bool(on))
 
     # management surface (the special key space's commit-time handles)
     def exclude_storage(self, sid):

@@ -19,6 +19,7 @@ from foundationdb_tpu.resolver.resolver import Resolver
 from foundationdb_tpu.server.coordination import (
     CoordinationQuorum, CoordinatorDown, GenerationConflict,
 )
+from foundationdb_tpu.server import consistencyscan as consistencyscan_mod
 from foundationdb_tpu.server.datadistribution import DataDistributor
 from foundationdb_tpu.server.grv import GrvProxy
 from foundationdb_tpu.server import health as health_mod
@@ -253,6 +254,12 @@ class Cluster:
         # its windows inherit their survive-recovery/absorb-on-shrink
         # semantics and never rewind
         self.history = timeseries_mod.HistoryCollector(self)
+        # ── continuous consistency scan (server/consistencyscan.py) ──
+        # the fifth cluster-owned subsystem: the background replica
+        # auditor's stats ride a cluster-held registry and its cursor
+        # persists in \xff/consistencyScan/, so rounds survive both
+        # txn-system recoveries and full restarts
+        self.scanner = consistencyscan_mod.ConsistencyScanner(self)
         # multi-region replication (server/region.py): None until a
         # region config attaches; the frontend below reads it, so the
         # attribute must exist before _build_txn_frontend
@@ -260,6 +267,11 @@ class Cluster:
         self.commit_proxy, self.grv_proxy = self._build_txn_frontend()
         if recovered_records:
             self._restore_tenant_config()
+            # resume the consistency scan where the old incarnation
+            # left it (cursor + round count live beside the shard map
+            # in the system keyspace) — a restart must not rewind a
+            # round that was minutes from completing
+            self.scanner.restore_cursor()
         # region config: constructor argument wins; otherwise a
         # recovered \xff/conf/regions row re-attaches replication (the
         # config persists beside the replication factor — `configure
@@ -287,6 +299,9 @@ class Cluster:
         # maybe_collect() themselves
         if commit_pipeline == "thread" and knobs.history_enabled:
             self.history.start()
+        # the scanner too: daemon loop XOR sim pump, never both
+        if commit_pipeline == "thread" and knobs.consistency_scan_enabled:
+            self.scanner.start()
 
     def _restore_tenant_config(self):
         """Re-apply persisted tenant mode + quotas + lock state after
@@ -835,6 +850,7 @@ class Cluster:
     def close(self):
         """Release background machinery (batcher threads, thread pools)
         and durable handles."""
+        self.scanner.stop()
         self.prober.stop()
         self.history.stop()
         if self.regions is not None:
@@ -1498,6 +1514,21 @@ class Cluster:
         return {**self.history.recorder.summary(),
                 "artifact": self.history.recorder.latest()}
 
+    def consistency_scan_status(self):
+        """The continuous consistency-scan document
+        (``consistency_scan`` RPC / \\xff\\xff/status/consistency_scan
+        / fdbcli scan status): round, progress, bytes/keys scanned,
+        and confirmed inconsistencies — a pure read (no batch runs
+        here)."""
+        return self.scanner.status()
+
+    def set_consistency_scan(self, on):
+        """Flip the scanner's module kill switch (fdbcli scan on|off /
+        the set_consistency_scan RPC). The scan document stays readable
+        either way; returns it so callers see the new state."""
+        consistencyscan_mod.set_enabled(bool(on))
+        return self.consistency_scan_status()
+
     def _trace_status(self):
         """The trace/span pipeline's own health: per-type suppression
         (satellite of flow/Trace.cpp event suppression) and the tracing
@@ -1599,6 +1630,11 @@ class Cluster:
                 # so status-file consumers (tools/doctor.py --trend)
                 # see trajectories without a second RPC
                 "history": self.history_status(),
+                # continuous consistency scan (consistencyscan.py):
+                # the background auditor's round/progress/verdict —
+                # the machine-checkable "is the data still consistent"
+                # instrument the sim swarm and doctor read
+                "consistency_scan": self.consistency_scan_status(),
                 # observability plumbing health: process-wide (cumulative
                 # across incarnations, so kept OUT of the deterministic
                 # per-cluster metrics section) — the trace sink's

@@ -9,12 +9,20 @@ simulation workload after every fault scenario and as an operator tool
 (consistencycheck in fdbcli); ours is both (sim tests call it after
 kill/recruit rounds, tools/cli.py exposes it).
 
+The per-shard replica comparison is ``consistencyscan.
+compare_shard_batch`` — the SAME code path the continuous background
+scanner (server/consistencyscan.py) walks in bounded batches, so the
+one-shot check and the always-on scan can never disagree about what
+"consistent" means.
+
 Returns a list of human-readable error strings — empty means consistent.
 """
 
-from foundationdb_tpu.utils.trace import SEV_ERROR, TraceEvent
+from foundationdb_tpu.server.consistencyscan import (
+    SYSTEM_END, compare_shard_batch,
+)
 
-SYSTEM_END = b"\xff\xff"  # past user + system keys (engine meta excluded)
+__all__ = ["SYSTEM_END", "consistency_check"]
 
 
 def consistency_check(cluster, max_keys_per_shard=None):
@@ -42,54 +50,13 @@ def consistency_check(cluster, max_keys_per_shard=None):
             if not 0 <= sid < n_storages:
                 errors.append(f"shard {i} references unknown storage {sid}")
 
-    # ── replica data comparison, shard by shard ──
+    # ── replica data comparison, shard by shard (the shared core) ──
     for i in range(len(smap)):
         begin, end = smap.shard_range(i)
         end = SYSTEM_END if end is None else end
-        team = smap.teams[i]
-        live = [
-            sid for sid in team
-            if 0 <= sid < n_storages and cluster.storages[sid].alive
-        ]
-        if not live:
-            errors.append(f"shard {i} [{begin!r}, {end!r}) has no live replica")
-            continue
-        datasets = []
-        for sid in live:
-            s = cluster.storages[sid]
-            try:
-                rows = s.read_range(
-                    begin, end, version, limit=max_keys_per_shard,
-                )
-            except Exception as e:
-                # the error lands in the report AND the trace stream: a
-                # sim run greps traces for forensics, and an operator's
-                # consistencycheck may summarize away the detail (FL005)
-                TraceEvent("ConsistencyCheckReadError",
-                           severity=SEV_ERROR).detail(
-                    shard=i, storage=sid, version=version,
-                    etype=type(e).__name__, error=str(e)[:200]).log()
-                errors.append(
-                    f"shard {i} replica {sid} unreadable at v{version}: {e}"
-                )
-                continue
-            datasets.append((sid, rows))
-        if len(datasets) < 2:
-            continue
-        ref_sid, ref_rows = datasets[0]
-        for sid, rows in datasets[1:]:
-            if rows == ref_rows:
-                continue
-            ref_map, got_map = dict(ref_rows), dict(rows)
-            missing = sorted(set(ref_map) - set(got_map))[:3]
-            extra = sorted(set(got_map) - set(ref_map))[:3]
-            diff = sorted(
-                k for k in set(ref_map) & set(got_map)
-                if ref_map[k] != got_map[k]
-            )[:3]
-            errors.append(
-                f"shard {i} [{begin!r}, {end!r}) replicas {ref_sid} vs "
-                f"{sid} diverge at v{version}: missing={missing} "
-                f"extra={extra} differing={diff}"
-            )
+        res = compare_shard_batch(
+            cluster, i, begin, end, smap.teams[i], version,
+            limit=max_keys_per_shard,
+        )
+        errors.extend(res.errors)
     return errors

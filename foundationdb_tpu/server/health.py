@@ -290,6 +290,10 @@ _MESSAGES = {
                             "endpoints marked failed; calls to them "
                             "are being skipped until a recovery probe "
                             "succeeds.",
+    "data_inconsistent": "The consistency scan confirmed replica "
+                         "divergence (re-read against the live shard "
+                         "map); the data is corrupt on at least one "
+                         "replica.",
 }
 
 
@@ -403,6 +407,21 @@ def build_health(cluster):
     }
     if probe_doc["last_error"] is not None:
         degraded.add("probe_failures")
+    # ── continuous consistency scan (server/consistencyscan.py) ──
+    # a CONFIRMED inconsistency (survived the live-map re-read) is a
+    # degraded verdict: the database still serves, but at least one
+    # replica holds corrupt data. The verdict transition makes the
+    # flight recorder dump the black box automatically.
+    scanner = getattr(cluster, "scanner", None)
+    scan_doc = scanner.status() if scanner is not None else {
+        "enabled": False, "round": 0, "progress_pct": 0.0, "cursor": "",
+        "batches": 0, "keys_scanned": 0, "bytes_scanned": 0,
+        "last_round_ms": 0.0, "round_age_s": 0.0,
+        "inconsistencies": 0, "reread_saves": 0,
+        "last_error": None, "errors": [],
+    }
+    if scan_doc["inconsistencies"]:
+        degraded.add("data_inconsistent")
     # ── trend-aware early warning (utils/timeseries.py) ──
     # a probe p99 rising monotonically across doctor_trend_windows
     # history windows degrades the verdict BEFORE the instant
@@ -433,6 +452,7 @@ def build_health(cluster):
              "description": _MESSAGES.get(r, r)} for r in reasons
         ],
         "probe": probe_doc,
+        "consistency_scan": scan_doc,
         "trend_alerts": trend_alerts,
         "recovery": rec,
         "lag": {

@@ -107,10 +107,15 @@ class Simulation:
     SIM_DT = 0.001
 
     def __init__(self, seed=0, buggify=True, crash_p=0.002, n_resolvers=1,
-                 datadir=None, engine="memory", machines=0, **cluster_kwargs):
+                 datadir=None, engine="memory", machines=0, corrupt_p=0.0,
+                 **cluster_kwargs):
         self.seed = seed
         self.engine_kind = engine  # "memory" | "versioned" | "redwood" | "sqlite"
         self.rng = random.Random(seed)
+        # silent-corruption fault arming (corrupt_replica): 0 keeps the
+        # buggify site cold — existing seeds' fault schedules must not
+        # shift — so chaos tests arm it explicitly, like crash_p
+        self.corrupt_p = corrupt_p
         # seed the process-wide determinism registry: cluster-visible
         # entropy (proposer ids, directory HCA draws, idempotency ids,
         # cluster-file ids) replays identically for the same seed — the
@@ -291,6 +296,19 @@ class Simulation:
             # cut identical windows (and the flight recorder dumps
             # identical artifacts)
             self.cluster.history.maybe_collect()
+            # continuous consistency scan: the sim scheduler drives the
+            # bounded-batch auditor exactly where a thread deployment's
+            # daemon loop would — cadence off the injected clock + the
+            # "consistency-scan" deterministic stream, so same-seed
+            # runs compare identical batches at identical steps
+            self.cluster.scanner.maybe_scan()
+            # buggify-keyed silent-corruption fault: flip one byte in
+            # one replica's engine; the scan must catch it within a
+            # round (chaos tests arm the site via corrupt_p)
+            if self.corrupt_p and self.buggify(
+                "corrupt_replica", fire_p=self.corrupt_p
+            ):
+                self.corrupt_replica()
         self._actors = []
         # surface WHICH buggify sites this seed activated: a failing
         # seed's repro starts from this line (and a same-seed rerun
@@ -482,6 +500,41 @@ class Simulation:
             step=self.steps,
             region=(c.regions.config.primary
                     if c.regions is not None else None)).log()
+
+    def corrupt_replica(self):
+        """Sim-only silent-corruption fault (ref: sim2's BUGGIFY disk
+        corruption): flip one byte of one live key's value in exactly
+        ONE replica's engine — below the storage server's overlay, via
+        the engine's own write op, so it works on every engine kind
+        (memory, sqlite, versioned, redwood) and survives a restart
+        like real bit rot would. Only a shard with >=2 live replicas is
+        eligible (a lone replica has nothing to diverge from). Returns
+        (sid, key) or None if no eligible replica/key exists."""
+        c = self.cluster
+        smap = c.dd.map
+        shard_order = list(range(len(smap)))
+        self.rng.shuffle(shard_order)
+        for i in shard_order:
+            begin, end = smap.shard_range(i)
+            end = b"\xff" if end is None or end > b"\xff" else end
+            if begin >= end:
+                continue  # user keys only: system rows self-heal on replay
+            team = [sid for sid in smap.teams[i]
+                    if 0 <= sid < len(c.storages) and c.storages[sid].alive]
+            if len(team) < 2:
+                continue
+            sid = team[self.rng.randrange(len(team))]
+            eng = c.storages[sid].engine
+            rows = [(k, v) for k, v in eng.get_range(begin, end, limit=32)
+                    if v]
+            if not rows:
+                continue
+            key, value = rows[self.rng.randrange(len(rows))]
+            eng.set(key, bytes([value[0] ^ 0x01]) + value[1:])
+            TraceEvent("SimCorruptReplica", severity=30).detail(
+                step=self.steps, storage=sid, key=key[:40]).log()
+            return sid, key
+        return None
 
     def _maybe_reboot_machine(self):
         if not self.buggify("machine_reboot", fire_p=0.0015):

@@ -65,6 +65,13 @@ LOWER_BETTER = (
     # "commit_rate" below, which is the intent: a decaying trajectory
     # shrinking toward 0 is the regression signature)
     "flight_dumps",
+    # continuous consistency scan (ISSUE 20): any confirmed replica
+    # inconsistency is a regression outright ("scan_round_ms" /
+    # "scan_last_round_ms" already resolve lower-better via "_ms";
+    # "scan_overhead_pct" via "overhead_pct"). NOTE: keep bare
+    # "scan_round" OUT of this tuple — it would shadow the
+    # higher-better "scan_rounds" below, since LOWER_BETTER wins ties
+    "scan_inconsistencies",
 )
 HIGHER_BETTER = (
     "txns_per_sec", "value", "vs_baseline", "speedup", "reuse_rate",
@@ -88,6 +95,11 @@ HIGHER_BETTER = (
     # run means the collector kept cutting on cadence — fewer would
     # mean stalls or a silently disabled collector
     "history_windows",
+    # continuous consistency scan (ISSUE 20): more completed rounds and
+    # more keyspace covered over the same run mean a healthier auditor
+    # ("scan_inconsistencies" resolves lower-better above, FIRST — it
+    # must never ride these substrings)
+    "scan_rounds", "scan_progress",
 )
 # relative change below this is measurement noise, not a trend
 REGRESSION_THRESHOLD_PCT = 5.0

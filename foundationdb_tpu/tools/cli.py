@@ -140,6 +140,7 @@ class Cli:
             "  top [conflict|read|write] [K]   hottest key ranges + tags",
             "  profile [json]                  device-path dispatch profile",
             "  doctor [json]                   health verdict + SLO alerts",
+            "  scan status|on|off              continuous consistency scan",
             "  history [METRIC|json]           metrics history windows",
             "  metacluster create|status|register|attach|remove|tenant",
             "  tracing status|on|off|sample RATE   distributed tracing",
@@ -359,7 +360,8 @@ class Cli:
 
     def _cmd_consistencycheck(self, args):
         """Ref: fdbcli consistencycheck — audit replica agreement across
-        every shard's team at the current committed version."""
+        every shard's team at the current committed version (the same
+        batch-compare core the continuous scan walks)."""
         errors = self.db._cluster.consistency_check()
         if not errors:
             self._p("Consistency check: PASS")
@@ -367,6 +369,58 @@ class Cli:
             self._p(f"Consistency check: FAIL ({len(errors)} errors)")
             for e in errors[:20]:
                 self._p(f"  {e}")
+        # the continuous scan role's stats ride along when it is live
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        try:
+            doc = json.loads(
+                self._run(lambda tr: tr.get(sk.CONSISTENCY_SCAN))
+            )
+        except (FDBError, ValueError, TypeError):
+            return
+        if doc.get("enabled"):
+            self._print_scan(doc)
+
+    def _print_scan(self, doc):
+        state = "enabled" if doc.get("enabled") else "disabled"
+        self._p(
+            f"Consistency scan: {state}",
+            f"  Rounds complete     - {doc.get('round', 0)} "
+            f"(last {doc.get('last_round_ms', 0.0)} ms)",
+            f"  Progress            - {doc.get('progress_pct', 0.0)}% "
+            f"({doc.get('batches', 0)} batches)",
+            f"  Scanned             - {doc.get('keys_scanned', 0)} keys "
+            f"/ {doc.get('bytes_scanned', 0)} bytes",
+            f"  Inconsistencies     - {doc.get('inconsistencies', 0)} "
+            f"({doc.get('reread_saves', 0)} dismissed by re-read)",
+        )
+        for e in (doc.get("errors") or [])[:5]:
+            self._p(f"  ERROR {e}")
+
+    def _cmd_scan(self, args):
+        """Continuous consistency scan (server/consistencyscan.py):
+        ``scan status [json]`` prints the background auditor's document
+        — read through the ``\\xff\\xff/status/consistency_scan``
+        special key so the same command works against remote clusters —
+        and ``scan on|off`` flips the scanner's kill switch."""
+        from foundationdb_tpu.txn import specialkeys as sk
+
+        sub = args[0] if args else "status"
+        if sub in ("on", "off"):
+            doc = self.db._cluster.set_consistency_scan(sub == "on")
+            state = "enabled" if doc.get("enabled") else "disabled"
+            self._p(f"Consistency scan {state}.")
+            return
+        if sub != "status":
+            self._p(f"ERROR: unknown scan subcommand `{sub}'")
+            return
+        doc = json.loads(
+            self._run(lambda tr: tr.get(sk.CONSISTENCY_SCAN))
+        )
+        if len(args) > 1 and args[1] == "json":
+            self._p(json.dumps(doc, indent=2, sort_keys=True))
+            return
+        self._print_scan(doc)
 
     def _cmd_configure(self, args):
         """Ref: fdbcli `configure` → changeConfig. Supported:

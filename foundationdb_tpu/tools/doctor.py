@@ -52,6 +52,47 @@ def trend_check(history_doc, windows=None, min_rise_pct=None):
     ]
 
 
+def scan_check(scan, max_round_age_s=None):
+    """Consistency-scan SLOs (tools/doctor.py --scan): one scan doc in,
+    alerts out — pure like ``check()``. Two invariants: confirmed
+    inconsistencies must be ZERO (the scanner already dismissed
+    split/move artifacts via its live-map re-read, so any survivor is
+    real corruption), and the last completed round must be fresher than
+    the age bound (a stalled scanner is a blind cluster)."""
+    th = (max_round_age_s if max_round_age_s is not None
+          else DEFAULT_KNOBS.doctor_scan_max_round_age_s)
+    alerts = []
+    if not isinstance(scan, dict) or not scan:
+        return alerts
+    inc = scan.get("inconsistencies", 0) or 0
+    if inc:
+        alerts.append(
+            f"scan: {inc} confirmed replica inconsistencies "
+            "(data_inconsistent)"
+        )
+        for e in (scan.get("errors") or [])[:3]:
+            alerts.append(f"scan: {e}")
+    if scan.get("enabled"):
+        age = scan.get("round_age_s")
+        if age is not None and age > th:
+            alerts.append(
+                f"scan: last completed round is {age}s old, over {th}s"
+            )
+    return alerts
+
+
+def extract_scan(doc):
+    """Accept a bare scan doc, a full status doc, or its ``cluster``
+    section — whichever the source produced."""
+    if not isinstance(doc, dict):
+        return {}
+    if "inconsistencies" in doc:
+        return doc
+    if "cluster" in doc:
+        return doc["cluster"].get("consistency_scan", {})
+    return doc.get("consistency_scan", {})
+
+
 def extract_history(doc):
     """Accept a bare history doc, a full status doc, or its ``cluster``
     section — whichever the source produced."""
@@ -187,6 +228,10 @@ def main(argv=None, out=None, sleep=time.sleep):
                          "probe-p99 rises (alerts before the SLO breaks)")
     ap.add_argument("--trend-windows", type=int, default=None)
     ap.add_argument("--trend-min-rise-pct", type=float, default=None)
+    ap.add_argument("--scan", action="store_true",
+                    help="also check the continuous consistency scan "
+                         "(inconsistencies == 0, round age bound)")
+    ap.add_argument("--scan-max-round-age-s", type=float, default=None)
     ap.add_argument("--json", action="store_true", dest="as_json")
     ns = ap.parse_args(argv)
     thresholds = {
@@ -215,6 +260,12 @@ def main(argv=None, out=None, sleep=time.sleep):
         with open(ns.status_file) as f:
             return extract_history(json.load(f))
 
+    def poll_scan():
+        if remote is not None:
+            return remote.consistency_scan_status()
+        with open(ns.status_file) as f:
+            return extract_scan(json.load(f))
+
     try:
         rounds = 1 if ns.watch is None else ns.watch
         n = 0
@@ -226,6 +277,9 @@ def main(argv=None, out=None, sleep=time.sleep):
                 alerts = alerts + trend_check(
                     poll_history(), ns.trend_windows,
                     ns.trend_min_rise_pct)
+            if ns.scan:
+                alerts = alerts + scan_check(
+                    poll_scan(), ns.scan_max_round_age_s)
             _report(health, alerts, verdict, ns.as_json, out)
             n += 1
             if rounds and n >= rounds:

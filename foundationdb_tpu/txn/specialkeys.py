@@ -52,6 +52,10 @@ HISTORY = b"\xff\xff/metrics/history"
 # flight recorder (utils/timeseries.py): dump summary + the newest
 # black-box artifact — what tools/flight.py reads from a live cluster
 FLIGHT = b"\xff\xff/status/flight"
+# continuous consistency scan (server/consistencyscan.py): round,
+# progress, bytes/keys scanned, confirmed inconsistencies — what
+# `fdbcli scan status` and tools/doctor.py --scan poll
+CONSISTENCY_SCAN = b"\xff\xff/status/consistency_scan"
 CONNECTION_STRING = b"\xff\xff/connection_string"
 CONFLICTING_KEYS = b"\xff\xff/transaction/conflicting_keys/"
 EXCLUDED = b"\xff\xff/management/excluded/"
@@ -175,6 +179,18 @@ def _flight_json(tr):
     return json.dumps(doc, sort_keys=True, default=repr).encode()
 
 
+def _scan_json(tr):
+    """The consistency-scan document alone (round, progress, verdict
+    counters) — what `fdbcli scan status` and tools/doctor.py --scan
+    poll."""
+    cluster = tr._cluster
+    if hasattr(cluster, "consistency_scan_status"):
+        doc = cluster.consistency_scan_status()
+    else:  # remote clusters without the endpoint: slice the status doc
+        doc = tr.db.status().get("cluster", {}).get("consistency_scan", {})
+    return json.dumps(doc, sort_keys=True).encode()
+
+
 def _tracing_rows(tr):
     """The tracing module's materialized rows (cluster config + this
     transaction's token), RYW-overlaid with pending tracing writes."""
@@ -232,6 +248,8 @@ def get(tr, key):
         return _history_json(tr)
     if key == FLIGHT:
         return _flight_json(tr)
+    if key == CONSISTENCY_SCAN:
+        return _scan_json(tr)
     if key == CONNECTION_STRING:
         return tr._cluster.connection_string().encode()
     if key == DB_LOCKED:
@@ -276,6 +294,8 @@ def get_range(tr, begin, end, limit=0, reverse=False):
         rows.append((HISTORY, get(tr, HISTORY)))
     if begin <= FLIGHT < end:
         rows.append((FLIGHT, get(tr, FLIGHT)))
+    if begin <= CONSISTENCY_SCAN < end:
+        rows.append((CONSISTENCY_SCAN, get(tr, CONSISTENCY_SCAN)))
     if begin <= CONNECTION_STRING < end:
         rows.append((CONNECTION_STRING, get(tr, CONNECTION_STRING)))
     rows += [

@@ -49,6 +49,10 @@ def test_compact_summary_is_small_and_headline_last():
         "probe_grv_p99_ms": 0.06, "probe_commit_p99_ms": 9.8,
         "recovery_count": 1, "last_recovery_ms": 12.5,
         "health_verdict": "healthy",
+        # continuous consistency scan (ISSUE 20): rounds completed,
+        # progress, and the zero inconsistencies that must still ride
+        "scan_rounds": 4, "scan_progress_pct": 62.5,
+        "scan_inconsistencies": 0,
         # multi-region replication (ISSUE 14)
         "region_mode": "sync", "replication_lag_ms": 0.0,
         "region_failovers": 0,
@@ -121,6 +125,11 @@ def test_compact_summary_is_small_and_headline_last():
     assert line["recovery_count"] == 1
     assert line["last_recovery_ms"] == 12.5
     assert line["health_verdict"] == "healthy"
+    # the scan gauges ride the summary — zero inconsistencies included,
+    # so a first nonzero is visible in the trajectory
+    assert line["scan_rounds"] == 4
+    assert line["scan_progress_pct"] == 62.5
+    assert line["scan_inconsistencies"] == 0
     # the region gauges ride the summary — including the zero failover
     # count, whose absence would be ambiguous
     assert line["region_mode"] == "sync"
@@ -223,6 +232,10 @@ def test_e2e_line_folds_proxies_and_platform():
                 "probe_grv_p99_ms", "probe_commit_p99_ms",
                 "recovery_count", "last_recovery_ms",
                 "health_verdict",
+                # continuous consistency scan (ISSUE 20): every line
+                # carries the rounds/progress/inconsistency gauges
+                "scan_rounds", "scan_progress_pct",
+                "scan_inconsistencies", "scan_round_ms",
                 # multi-region replication (ISSUE 14): every line says
                 # whether a satellite region rode along and what it cost
                 "region_mode", "replication_lag_ms",
@@ -239,6 +252,10 @@ def test_e2e_line_folds_proxies_and_platform():
     # healthy with an empty recovery timeline
     assert fields["health_verdict"] == "healthy"
     assert fields["recovery_count"] == 0
+    # the scanner audited a healthy cluster: zero confirmed
+    # inconsistencies — anything else is a false-positive bug
+    assert fields["scan_inconsistencies"] == 0
+    assert fields["scan_rounds"] >= 0
     # in-process, fault-free: no deadline ever expired and no endpoint
     # was ever marked failed (nonzero here would mean the robustness
     # stack fired on a healthy run)
@@ -347,6 +364,32 @@ def test_history_smoke_contract():
     from foundationdb_tpu.utils import timeseries as ts_mod
 
     assert ts_mod.enabled()
+
+
+def test_scan_smoke_contract():
+    """BENCH_MODE=scan_smoke: the consistency-scan overhead probe emits
+    the budget fields plus the rounds/progress/inconsistency observables
+    from the enabled arm, and restores the kill switch. One short round
+    checks the contract; the bench run owns the statistically serious
+    comparison."""
+    out = bench.run_scan_smoke(cpu=True, seconds=0.5, rounds=1)
+    for key in ("value", "vs_baseline", "disabled_txns_per_sec",
+                "scan_overhead_pct", "overhead_budget_pct",
+                "within_budget", "scan_rounds", "scan_progress_pct",
+                "scan_inconsistencies", "scan_round_ms",
+                "health_verdict", "commit_p50_ms", "commit_p99_ms",
+                "grv_p99_ms"):
+        assert key in out, key
+    assert out["metric"] == "e2e_scan_smoke"
+    assert out["overhead_budget_pct"] == 2.0
+    # a healthy smoke run must confirm ZERO inconsistencies — any
+    # nonzero here is a false-positive bug in the scanner
+    assert out["scan_inconsistencies"] == 0
+    assert out["health_verdict"] == "healthy"
+    # the probe restored the kill switch (the scan stays default-on)
+    from foundationdb_tpu.server import consistencyscan as scan_mod
+
+    assert scan_mod.enabled()
 
 
 def test_region_smoke_contract():

@@ -147,6 +147,40 @@ def test_fl001_seamed_scheduler_tiebreak_passes():
     assert findings == []
 
 
+def test_fl001_flags_wall_clock_scan_cadence():
+    """The continuous consistency scan's cadence must ride the injected
+    clock and the named 'consistency-scan' stream — wall time + ambient
+    entropy would make same-seed sims compare different batches at
+    different steps (ISSUE 20 satellite)."""
+    findings = lint("server/consistencyscan.py", """
+        import random
+        import time
+
+        def maybe_scan(self):
+            now = time.time()
+            if now < self._next_due:
+                return False
+            self._next_due = now + 0.25 * (0.5 + random.random())
+            return True
+    """)
+    assert rules_of(findings) == ["FL001", "FL001"]
+
+
+def test_fl001_scan_cadence_on_the_seam_passes():
+    findings = lint("server/consistencyscan.py", """
+        from foundationdb_tpu.core import deterministic
+
+        def maybe_scan(self):
+            now = deterministic.now()
+            if now < self._next_due:
+                return False
+            rng = deterministic.rng("consistency-scan")
+            self._next_due = now + 0.25 * (0.5 + rng.random())
+            return True
+    """)
+    assert findings == []
+
+
 def test_fl001_flags_manual_backoff_loop():
     """A retry loop that sleeps a delay it grows by hand bypasses the
     Backoff seam: unjittered (lockstep fleets) and off the seeded
